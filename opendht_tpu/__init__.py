@@ -1,0 +1,26 @@
+"""opendht_tpu — a TPU-native distributed hash table framework.
+
+A ground-up re-design of the capabilities of OpenDHT (reference:
+``Dale-M/opendht`` @ /root/reference, surveyed in SURVEY.md): a Kademlia
+DHT with ``get/put/listen/query`` value store, signed/encrypted values,
+write tokens, a REST proxy and a Python-first API — with the routing
+core re-architected as batched JAX/XLA kernels over HBM-resident
+node-ID matrices instead of scalar per-search loops.
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``ops``        L0 device kernels: 160-bit ID math, XOR top-k, radix partition
+- ``core``       L2 data structures: node table, routing, batched search, storage, values
+- ``net``        L1 host network engine: msgpack wire protocol over asyncio UDP
+- ``crypto``     L0/L3 identities, sign/encrypt (SecureDht overlay)
+- ``runtime``    L4 Dht core + DhtRunner façade + scheduler
+- ``parallel``   multi-chip sharded tables (jax.sharding Mesh + shard_map)
+- ``proxy``      REST proxy server/client
+- ``indexation`` PHT (prefix hash tree) distributed index
+- ``tools``      dhtnode / dhtchat / dhtscanner CLI equivalents
+- ``sim``        in-process cluster + device-level lookup simulators
+"""
+
+__version__ = "0.1.0"
+
+from .infohash import InfoHash, PkId, random_infohash  # noqa: F401

@@ -1,0 +1,20 @@
+"""Test config: force the CPU backend with 8 virtual devices so that
+multi-chip sharding paths (jax.sharding.Mesh / shard_map) are exercised
+without TPU hardware.
+
+Note: this environment registers an 'axon' TPU-tunnel backend via
+sitecustomize and forces jax_platforms=axon; the tunnel admits a single
+client, so tests must never touch it (the benchmark owns it).  Setting
+the env var is not enough — the registration hook overrides it — but a
+config update before first backend use wins.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,174 @@
+"""Device ID kernels vs the scalar host oracle.
+
+The host :class:`opendht_tpu.infohash.InfoHash` implements the reference
+semantics (include/opendht/infohash.h) scalar-wise; these tests check the
+vectorized uint32-limb kernels produce bit-identical results, including
+the exact vectors from tests/infohashtester.cpp.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.ops import ids as K
+
+
+def _rand_hashes(n, seed):
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 256, size=(n, 20), dtype=np.uint8)
+    # sprinkle structured cases: zeros, shared prefixes, single bits
+    raw[0] = 0
+    raw[1] = 0
+    raw[1][19] = 0x10
+    raw[2] = 0
+    raw[2][0] = 0x01
+    if n > 4:
+        raw[4] = raw[3]  # exact duplicate
+    if n > 6:
+        raw[6][:10] = raw[5][:10]  # long shared prefix
+    return [InfoHash(bytes(r)) for r in raw], raw
+
+
+def test_bytes_roundtrip():
+    hashes, raw = _rand_hashes(64, 0)
+    limbs = K.ids_from_bytes(raw)
+    assert limbs.shape == (64, 5)
+    assert limbs.dtype == np.uint32
+    back = K.ids_to_bytes(limbs)
+    np.testing.assert_array_equal(back, raw)
+    limbs2 = K.ids_from_hashes(hashes)
+    np.testing.assert_array_equal(limbs, limbs2)
+
+
+def test_lex_ordering_matches_bytes():
+    hashes, raw = _rand_hashes(128, 1)
+    a = jnp.asarray(K.ids_from_bytes(raw))
+    b = jnp.roll(a, 1, axis=0)
+    hb = hashes[-1:] + hashes[:-1]
+    want_lt = np.array([x._b < y._b for x, y in zip(hashes, hb)])
+    want_cmp = np.array([InfoHash.cmp(x, y) for x, y in zip(hashes, hb)])
+    np.testing.assert_array_equal(np.asarray(K.lex_lt(a, b)), want_lt)
+    np.testing.assert_array_equal(np.asarray(K.lex_cmp(a, b)), want_cmp)
+    np.testing.assert_array_equal(
+        np.asarray(K.lex_eq(a, b)), np.array([x == y for x, y in zip(hashes, hb)])
+    )
+
+
+def test_xor_cmp_parity_including_cpp_vectors():
+    # exact vectors from tests/infohashtester.cpp:125-138
+    null_h = InfoHash()
+    min_h = InfoHash("0000000000000000000000000000000000000010")
+    max_h = InfoHash("0100000000000000000000000000000000000000")
+    triples = [
+        (min_h, null_h, max_h, -1),
+        (min_h, max_h, null_h, 1),
+        (min_h, min_h, max_h, -1),
+        (min_h, max_h, min_h, 1),
+        (null_h, min_h, max_h, -1),
+        (null_h, max_h, min_h, 1),
+        (max_h, null_h, min_h, -1),  # circular distance
+        (max_h, min_h, null_h, 1),
+    ]
+    s = K.ids_from_hashes([t[0] for t in triples])
+    a = K.ids_from_hashes([t[1] for t in triples])
+    b = K.ids_from_hashes([t[2] for t in triples])
+    got = np.asarray(K.xor_cmp(jnp.asarray(s), jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(got, [t[3] for t in triples])
+
+    # property test vs scalar oracle
+    hashes, raw = _rand_hashes(200, 2)
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, len(hashes), size=(500, 3))
+    s = jnp.asarray(K.ids_from_bytes(raw[idx[:, 0]]))
+    a = jnp.asarray(K.ids_from_bytes(raw[idx[:, 1]]))
+    b = jnp.asarray(K.ids_from_bytes(raw[idx[:, 2]]))
+    got = np.asarray(K.xor_cmp(s, a, b))
+    want = np.array(
+        [hashes[i].xor_cmp(hashes[j], hashes[k]) for i, j, k in idx]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_common_bits_parity():
+    # cpp vectors (tests/infohashtester.cpp:114-122)
+    null_h = InfoHash()
+    min_h = InfoHash("0000000000000000000000000000000000000010")
+    max_h = InfoHash("0100000000000000000000000000000000000000")
+    pairs = [(null_h, null_h, 160), (null_h, min_h, 155), (null_h, max_h, 7), (min_h, max_h, 7)]
+    a = jnp.asarray(K.ids_from_hashes([p[0] for p in pairs]))
+    b = jnp.asarray(K.ids_from_hashes([p[1] for p in pairs]))
+    np.testing.assert_array_equal(np.asarray(K.common_bits(a, b)), [p[2] for p in pairs])
+
+    hashes, raw = _rand_hashes(100, 4)
+    rng = np.random.default_rng(5)
+    idx = rng.integers(0, len(hashes), size=(400, 2))
+    a = jnp.asarray(K.ids_from_bytes(raw[idx[:, 0]]))
+    b = jnp.asarray(K.ids_from_bytes(raw[idx[:, 1]]))
+    got = np.asarray(K.common_bits(a, b))
+    want = np.array([InfoHash.common_bits(hashes[i], hashes[j]) for i, j in idx])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lowbit_parity():
+    # cpp vectors (tests/infohashtester.cpp:104-111)
+    vec = [
+        (InfoHash(), -1),
+        (InfoHash("0000000000000000000000000000000000000010"), 155),
+        (InfoHash("0100000000000000000000000000000000000000"), 7),
+    ]
+    a = jnp.asarray(K.ids_from_hashes([v[0] for v in vec]))
+    np.testing.assert_array_equal(np.asarray(K.lowbit(a)), [v[1] for v in vec])
+
+    hashes, raw = _rand_hashes(300, 6)
+    a = jnp.asarray(K.ids_from_bytes(raw))
+    got = np.asarray(K.lowbit(a))
+    want = np.array([h.lowbit() for h in hashes])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_get_set_bit_parity():
+    hashes, raw = _rand_hashes(64, 7)
+    a = jnp.asarray(K.ids_from_bytes(raw))
+    rng = np.random.default_rng(8)
+    bits = rng.integers(0, 160, size=64)
+    got = np.asarray(K.get_bit(a, jnp.asarray(bits)))
+    want = np.array([h.get_bit(int(b)) for h, b in zip(hashes, bits)])
+    np.testing.assert_array_equal(got, want)
+
+    vals = rng.integers(0, 2, size=64).astype(bool)
+    got_set = K.set_bit(a, jnp.asarray(bits), jnp.asarray(vals))
+    want_set = np.stack(
+        [K.ids_from_bytes(bytes(h.set_bit(int(b), bool(v))))[0]
+         for h, b, v in zip(hashes, bits, vals)]
+    )
+    np.testing.assert_array_equal(np.asarray(got_set), want_set)
+
+
+def test_bit_kernels():
+    x = jnp.asarray(
+        np.array([0, 1, 2, 3, 0x80000000, 0xFFFFFFFF, 0x00010000], dtype=np.uint32)
+    )
+    np.testing.assert_array_equal(np.asarray(K.popcount32(x)), [0, 1, 1, 2, 1, 32, 1])
+    np.testing.assert_array_equal(np.asarray(K.clz32(x)), [32, 31, 30, 30, 0, 0, 15])
+    np.testing.assert_array_equal(np.asarray(K.ctz32(x)), [32, 0, 1, 0, 31, 0, 16])
+
+
+def test_jit_and_vmap_compat():
+    hashes, raw = _rand_hashes(32, 9)
+    a = jnp.asarray(K.ids_from_bytes(raw))
+    b = jnp.flip(a, axis=0)
+    jcb = jax.jit(K.common_bits)
+    np.testing.assert_array_equal(np.asarray(jcb(a, b)), np.asarray(K.common_bits(a, b)))
+    vlow = jax.vmap(K.lowbit)
+    np.testing.assert_array_equal(
+        np.asarray(vlow(a.reshape(4, 8, 5))), np.asarray(K.lowbit(a)).reshape(4, 8)
+    )
+
+
+def test_random_ids_shape_dtype():
+    out = K.random_ids(jax.random.key(0), 16)
+    assert out.shape == (16, 5)
+    assert out.dtype == jnp.uint32

@@ -1,0 +1,114 @@
+"""Host InfoHash unit tests — ports the reference's CppUnit suite
+(reference: tests/infohashtester.cpp:38-138) plus extras."""
+
+import pytest
+
+from opendht_tpu.infohash import InfoHash, PkId
+
+
+def test_constructors():
+    # tests/infohashtester.cpp:38-74
+    null_hash = InfoHash()
+    assert len(null_hash) == 20
+    assert not null_hash
+
+    too_short = bytes([0, 1, 2, 3, 4, 5, 6, 7, 8])
+    h = InfoHash(too_short)
+    assert len(h) == 20
+    assert h.hex() == "0000000000000000000000000000000000000000"
+
+    enough = bytes([1, 2, 3, 4, 5, 6, 7, 8, 9, 10] * 2)
+    h = InfoHash(enough)
+    assert bytes(h) == enough
+
+    too_long = enough + b"\xb0"
+    h = InfoHash(too_long)
+    assert bytes(h) == enough
+
+    h2 = InfoHash("0102030405060708090A0102030405060708090A")
+    assert bytes(h2) == enough
+
+    # malformed hex → null (reference parses via sscanf, yielding garbage-
+    # tolerant behavior; we specify null)
+    assert not InfoHash("zz02030405060708090A0102030405060708090A")
+
+
+def test_comparators():
+    # tests/infohashtester.cpp:77-101
+    null_hash = InfoHash()
+    min_hash = InfoHash("0000000000000000000000000000000000111110")
+    max_hash = InfoHash("0111110000000000000000000000000000000000")
+
+    assert min_hash == min_hash
+    assert min_hash == InfoHash("0000000000000000000000000000000000111110")
+    assert not (min_hash == max_hash)
+    assert min_hash != max_hash
+    assert null_hash < min_hash
+    assert null_hash < max_hash
+    assert min_hash < max_hash
+    assert not (min_hash < null_hash)
+    assert not (max_hash < min_hash)
+    assert not (min_hash < min_hash)
+    assert bool(max_hash)
+    assert not bool(null_hash)
+
+
+def test_lowbit():
+    # tests/infohashtester.cpp:104-111
+    assert InfoHash().lowbit() == -1
+    assert InfoHash("0000000000000000000000000000000000000010").lowbit() == 155
+    assert InfoHash("0100000000000000000000000000000000000000").lowbit() == 7
+
+
+def test_common_bits():
+    # tests/infohashtester.cpp:114-122
+    null_hash = InfoHash()
+    min_hash = InfoHash("0000000000000000000000000000000000000010")
+    max_hash = InfoHash("0100000000000000000000000000000000000000")
+    assert InfoHash.common_bits(null_hash, null_hash) == 160
+    assert InfoHash.common_bits(null_hash, min_hash) == 155
+    assert InfoHash.common_bits(null_hash, max_hash) == 7
+    assert InfoHash.common_bits(min_hash, max_hash) == 7
+
+
+def test_xor_cmp():
+    # tests/infohashtester.cpp:125-138 (includes circular-distance cases)
+    null_hash = InfoHash()
+    min_hash = InfoHash("0000000000000000000000000000000000000010")
+    max_hash = InfoHash("0100000000000000000000000000000000000000")
+    assert min_hash.xor_cmp(null_hash, max_hash) == -1
+    assert min_hash.xor_cmp(max_hash, null_hash) == 1
+    assert min_hash.xor_cmp(min_hash, max_hash) == -1
+    assert min_hash.xor_cmp(max_hash, min_hash) == 1
+    assert null_hash.xor_cmp(min_hash, max_hash) == -1
+    assert null_hash.xor_cmp(max_hash, min_hash) == 1
+    assert max_hash.xor_cmp(null_hash, min_hash) == -1
+    assert max_hash.xor_cmp(min_hash, null_hash) == 1
+
+
+def test_get_and_bits():
+    h = InfoHash.get("hello")
+    # SHA1("hello")
+    assert h.hex() == "aaf4c61ddcc5e8a2dabede0f3b482cd9aea9434d"
+    assert h.get_bit(0) == bool(h[0] & 0x80)
+    flipped = h.set_bit(0, not h.get_bit(0))
+    assert flipped.get_bit(0) != h.get_bit(0)
+    assert flipped.set_bit(0, h.get_bit(0)) == h
+
+    p = PkId.get(b"hello")
+    assert len(p) == 32  # SHA256 for 32-byte ids (src/crypto.cpp:208-227)
+
+
+def test_random_and_roundtrip():
+    a = InfoHash.get_random()
+    b = InfoHash.get_random()
+    assert a != b  # 2^-160 failure probability
+    assert InfoHash(a.hex()) == a
+    assert InfoHash.from_int(a.to_int()) == a
+    assert 0.0 <= a.to_float() < 1.0
+
+
+def test_xor():
+    a = InfoHash.get_random()
+    assert not a.xor(a)
+    assert a.xor(InfoHash()) == a
