@@ -64,9 +64,11 @@ class Hash:
                 self._b = bytes(n)
             else:
                 try:
-                    self._b = bytes.fromhex(s)
+                    b = bytes.fromhex(s)
                 except ValueError:
-                    self._b = bytes(n)
+                    b = b""
+                # fromhex skips internal whitespace; enforce exact width
+                self._b = b if len(b) == n else bytes(n)
         else:
             raise TypeError(f"cannot build {type(self).__name__} from {type(value)}")
 
@@ -176,6 +178,10 @@ class Hash:
         return type(self)(bytes(arr))
 
     def xor(self, other: "Hash") -> "Hash":
+        if len(other._b) != self.HASH_LEN:
+            raise ValueError(
+                f"cannot xor {type(self).__name__} with {len(other._b)}-byte hash"
+            )
         return type(self)(bytes(x ^ y for x, y in zip(self._b, other._b)))
 
     # -- constructors ------------------------------------------------------

@@ -89,23 +89,12 @@ def random_ids(key, n: int):
 # ---------------------------------------------------------------------------
 
 def popcount32(x):
-    x = x.astype(_U32)
-    x = x - ((x >> 1) & _U32(0x55555555))
-    x = (x & _U32(0x33333333)) + ((x >> 2) & _U32(0x33333333))
-    x = (x + (x >> 4)) & _U32(0x0F0F0F0F)
-    return ((x * _U32(0x01010101)) >> 24).astype(jnp.int32)
+    return jax.lax.population_count(x.astype(_U32)).astype(jnp.int32)
 
 
 def clz32(x):
     """Count leading zeros of each uint32 (32 for x == 0)."""
-    x = x.astype(_U32)
-    y = x
-    y = y | (y >> 1)
-    y = y | (y >> 2)
-    y = y | (y >> 4)
-    y = y | (y >> 8)
-    y = y | (y >> 16)
-    return 32 - popcount32(y)
+    return jax.lax.clz(x.astype(_U32)).astype(jnp.int32)
 
 
 def ctz32(x):
@@ -198,15 +187,17 @@ def lowbit(a):
 
 def get_bit(a, nbit):
     """Bit `nbit` counting from the MSB (↔ Hash::getBit, infohash.h:196-202).
-    `nbit` may be a traced int32; broadcasts."""
-    nbit = jnp.asarray(nbit, dtype=jnp.int32)
+    `nbit` may be a scalar or batched traced int32; broadcasts against the
+    ids' batch shape.  Out-of-range indices are clamped to bit 159 (device
+    code can't raise; the host InfoHash.get_bit raises IndexError instead)."""
+    a = a.astype(_U32)
+    nbit = jnp.broadcast_to(
+        jnp.asarray(nbit, dtype=jnp.int32), a.shape[:-1]
+    )
+    nbit = jnp.clip(nbit, 0, ID_BITS - 1)
     limb_idx = nbit // 32
     bit_in_limb = 31 - (nbit % 32)  # from LSB of limb
-    limbs = jnp.take_along_axis(
-        a.astype(_U32),
-        limb_idx[..., None].astype(jnp.int32) % N_LIMBS,
-        axis=-1,
-    )[..., 0]
+    limbs = jnp.take_along_axis(a, limb_idx[..., None], axis=-1)[..., 0]
     return ((limbs >> bit_in_limb.astype(_U32)) & _U32(1)).astype(bool)
 
 
