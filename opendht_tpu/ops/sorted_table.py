@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .ids import N_LIMBS, xor_ids, common_bits
+from .ids import N_LIMBS, xor_ids, common_bits, lex_lt
 from .xor_topk import xor_topk
 
 _U32 = jnp.uint32
@@ -81,12 +81,7 @@ def _lower_bound(sorted_ids, queries, n_valid):
         lo, hi = lohi
         mid = (lo + hi) // 2
         mid_ids = jnp.take(sorted_ids, jnp.clip(mid, 0, N - 1), axis=0)
-        # mid_ids < q  (5-limb lexicographic)
-        lt = jnp.zeros((Q,), bool)
-        eq = jnp.ones((Q,), bool)
-        for i in range(N_LIMBS):
-            lt = lt | (eq & (mid_ids[:, i] < queries[:, i]))
-            eq = eq & (mid_ids[:, i] == queries[:, i])
+        lt = lex_lt(mid_ids, queries)   # mid < q, 5-limb lexicographic
         go_right = lt & (lo < hi)
         new_lo = jnp.where(go_right, mid + 1, lo)
         new_hi = jnp.where(go_right | (lo >= hi), hi, mid)
@@ -166,11 +161,13 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128):
 def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
                 fallback: bool = True):
     """Window lookup with exact fallback: uncertified queries re-run
-    through the full-scan oracle so the result is always exact.
+    through the full-scan oracle so the result is always exact (when
+    ``fallback=True``; with ``fallback=False`` rows where the returned
+    ``certified`` mask is False may be inexact).
 
     Host-level driver (the fallback set is data-dependent); the common
-    path is a single device call.  Returns (dist [Q,k,5], idx [Q,k]
-    int32 into the *sorted* table).
+    path is a single device call.  Returns (dist [Q,k,5],
+    idx [Q,k] int32 into the *sorted* table, certified [Q] bool).
     """
     dist, idx, cert = window_topk(sorted_ids, n_valid, queries, k=k, window=window)
     if not fallback:

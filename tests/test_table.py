@@ -1,0 +1,221 @@
+"""Routing layer tests: radix bucket kernels + NodeTable k-bucket
+semantics (reference behavior: src/routing_table.cpp, src/node_cache.cpp,
+include/opendht/node.h)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.ops import ids as K
+from opendht_tpu.ops import radix
+from opendht_tpu.core.table import (
+    NodeTable, NODE_GOOD_TIME, TARGET_NODES,
+)
+
+
+def _rand_hash(rng):
+    return InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+
+
+# ---------------------------------------------------------------- radix ops
+
+def test_bucket_of_matches_scalar():
+    rng = np.random.default_rng(0)
+    me = _rand_hash(rng)
+    hashes = [_rand_hash(rng) for _ in range(200)]
+    # include very close ids
+    close = me.set_bit(159, not me.get_bit(159))
+    hashes.append(close)
+    ids = jnp.asarray(K.ids_from_hashes(hashes))
+    got = np.asarray(radix.bucket_of(
+        jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1), ids))
+    want = np.array([
+        min(InfoHash.common_bits(me, h), 159) for h in hashes
+    ])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bucket_counts_and_last_seen():
+    rng = np.random.default_rng(1)
+    me = _rand_hash(rng)
+    hashes = [_rand_hash(rng) for _ in range(64)]
+    ids = jnp.asarray(K.ids_from_hashes(hashes))
+    valid = np.ones(64, bool)
+    valid[10] = False
+    ts = rng.uniform(0, 100, 64)
+    self_l = jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1)
+    counts = np.asarray(radix.bucket_counts(self_l, ids, jnp.asarray(valid)))
+    want = np.zeros(160, np.int32)
+    for i, h in enumerate(hashes):
+        if valid[i]:
+            want[min(InfoHash.common_bits(me, h), 159)] += 1
+    np.testing.assert_array_equal(counts, want)
+    assert counts.sum() == 63
+
+    last = np.asarray(radix.bucket_last_seen(
+        self_l, ids, jnp.asarray(valid), jnp.asarray(ts)))
+    for b in range(160):
+        sel = [ts[i] for i, h in enumerate(hashes)
+               if valid[i] and min(InfoHash.common_bits(me, h), 159) == b]
+        if sel:
+            assert last[b] == pytest.approx(max(sel))
+
+
+def test_random_id_in_bucket():
+    rng = np.random.default_rng(2)
+    me = _rand_hash(rng)
+    self_l = jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1)
+    buckets = jnp.asarray(np.array([0, 1, 7, 31, 32, 100, 158, 159]))
+    out = radix.random_id_in_bucket(self_l, buckets, jax.random.key(3))
+    raw = K.ids_to_bytes(np.asarray(out))
+    for j, b in enumerate(np.asarray(buckets)):
+        h = InfoHash(raw[j].tobytes())
+        assert InfoHash.common_bits(me, h) == b, f"bucket {b}"
+
+
+def test_estimate_network_size_order_of_magnitude():
+    rng = np.random.default_rng(4)
+    me = _rand_hash(rng)
+    for n in (64, 4096):
+        raw = rng.integers(0, 256, (n, 20), dtype=np.uint8)
+        est = int(radix.estimate_network_size(
+            jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1),
+            jnp.asarray(K.ids_from_bytes(raw)),
+            jnp.ones(n, bool), k=8,
+        ))
+        assert n / 4 <= est <= n * 4, (n, est)
+
+
+# ---------------------------------------------------------------- NodeTable
+
+def test_insert_dedupe_and_liveness():
+    rng = np.random.default_rng(5)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=16)
+    h = _rand_hash(rng)
+    row = t.insert(h, ("1.2.3.4", 4222), now=100.0, confirm=0)
+    assert row is not None and len(t) == 1
+    assert not t.is_good(row, 100.0)          # never replied
+    row2 = t.insert(h, ("1.2.3.4", 4222), now=101.0, confirm=2)
+    assert row2 == row and len(t) == 1        # dedupe
+    assert t.is_good(row, 101.0)
+    assert not t.is_good(row, 101.0 + NODE_GOOD_TIME + 1)  # aged out
+    # own id never inserted
+    assert t.insert(me, None, now=1.0) is None
+
+
+def test_bucket_capacity_and_replacement():
+    rng = np.random.default_rng(6)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=16)
+    # 9 nodes in bucket 0 (first bit differs from me)
+    nodes = []
+    while len(nodes) < 9:
+        h = _rand_hash(rng)
+        if InfoHash.common_bits(me, h) == 0:
+            nodes.append(h)
+    rows = [t.insert(h, i, now=10.0, confirm=2) for i, h in enumerate(nodes[:8])]
+    assert all(r is not None for r in rows)
+    # bucket full of live nodes → 9th rejected, kept as candidate
+    assert t.insert(nodes[8], 8, now=10.0, confirm=2) is None
+    assert len(t) == 8
+    # expire one → next insert replaces it
+    t.on_expired(nodes[0])
+    r9 = t.insert(nodes[8], 8, now=11.0, confirm=2)
+    assert r9 is not None and len(t) == 8
+    assert t.row_of(nodes[0]) is None
+    # removing a node promotes the bucket's cached candidate
+    extra = None
+    while extra is None:
+        h = _rand_hash(rng)
+        if InfoHash.common_bits(me, h) == 0:
+            extra = h
+    assert t.insert(extra, 99, now=12.0, confirm=2) is None   # cached
+    t.remove(nodes[1])
+    assert t.row_of(extra) is not None
+
+
+def test_auth_errors_expire():
+    rng = np.random.default_rng(7)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=16)
+    h = _rand_hash(rng)
+    row = t.insert(h, None, now=1.0, confirm=2)
+    for _ in range(3):
+        t.on_auth_error(h)
+    assert not t.is_good(row, 1.0)
+    t.clear_bad()
+    assert t.row_of(h) is None
+
+
+def test_find_closest_matches_oracle_and_growth():
+    rng = np.random.default_rng(8)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=8)          # force growth
+    hashes, rows = [], {}
+    for i in range(300):
+        h = _rand_hash(rng)
+        r = t.insert(h, i, now=50.0, confirm=2)
+        if r is not None:
+            hashes.append(h)
+            rows[bytes(h)] = r
+    # k-bucket admission: random ids concentrate in shallow buckets, so
+    # only ~k·log2(N/k) of the 300 are admitted
+    assert 24 <= len(t) <= 120
+
+    targets = [_rand_hash(rng) for _ in range(20)]
+    got_rows, got_dist = t.find_closest(targets, k=8, now=60.0)
+    for qi, tgt in enumerate(targets):
+        ti = tgt.to_int()
+        want = sorted(hashes, key=lambda h: ti ^ h.to_int())[:8]
+        got = [t.id_of(int(r)) for r in got_rows[qi] if r >= 0]
+        assert got == want, f"target {qi}"
+
+
+def test_find_closest_good_mask():
+    rng = np.random.default_rng(9)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=64)
+    good, stale = [], []
+    for i in range(20):
+        h = _rand_hash(rng)
+        t.insert(h, i, now=1000.0, confirm=2)
+        good.append(h)
+    for i in range(20):
+        h = _rand_hash(rng)
+        t.insert(h, i, now=1000.0, confirm=0)   # never replied → not good
+        stale.append(h)
+    tgt = _rand_hash(rng)
+    rows, _ = t.find_closest([tgt], k=8, now=1001.0, mask="good")
+    ids = {bytes(t.id_of(int(r))) for r in rows[0] if r >= 0}
+    assert ids <= {bytes(h) for h in good}
+    assert len(ids) == 8
+
+
+def test_bulk_load_and_maintenance():
+    rng = np.random.default_rng(10)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=64)
+    raw = rng.integers(0, 256, (500, 20), dtype=np.uint8)
+    t.bulk_load(K.ids_from_bytes(raw), now=100.0)
+    assert len(t) == 500
+    est = t.network_size_estimate()
+    assert 100 <= est <= 2000
+
+    # everything last seen at t=100 → all occupied buckets stale at t=1000
+    stale = t.stale_buckets(1000.0)
+    occ = np.nonzero(t.bucket_occupancy())[0]
+    np.testing.assert_array_equal(stale, occ)
+    # nothing stale shortly after
+    assert len(t.stale_buckets(101.0)) == 0
+
+    targets = t.refresh_targets(stale[:4], jax.random.key(0))
+    for j, b in enumerate(stale[:4]):
+        h = InfoHash(K.ids_to_bytes(targets[j]).tobytes())
+        assert InfoHash.common_bits(me, h) == b
+
+    exported = t.export_nodes(now=200.0)
+    assert len(exported) == 500
