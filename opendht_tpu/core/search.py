@@ -1,0 +1,347 @@
+"""Batched iterative Kademlia lookup engine.
+
+The reference resolves each ``get()`` with a sequential state machine:
+``Dht::searchStep`` (src/dht.cpp:561-654) keeps a sorted set of ≤ 14
+candidates per target (``Search::insertNode``, src/search.h:636-722),
+keeps α = 4 requests in flight (dht.h:321), inserts every reply's nodes
+back into the set, and is done when the first k = 8 candidates have all
+replied (``isSynced``, src/search.h:734-747).
+
+Here the *entire population of concurrent lookups* advances together:
+one device step selects the next α unqueried candidates for every one of
+Q searches, resolves all Q·α simulated replies against the global node
+matrix, and merges them back — all as fixed-shape array ops inside a
+``lax.while_loop``.  A million lookups cost a few dozen fused device
+steps instead of millions of scalar iterations.
+
+State layout (fixed shapes; "no candidate" = node index -1):
+
+    cand_node [Q, S]     int32   sorted-table index of each candidate
+    cand_dist [Q, S, 5]  uint32  XOR distance to the target (sort key)
+    queried   [Q, S]     int32   request sent
+    replied   [Q, S]     int32   reply merged
+    hops      [Q]        int32   rounds taken until convergence
+    done      [Q]        bool
+
+Simulated network model (for hop-count/convergence studies, mirroring
+the role of the reference's netns cluster harness,
+python/tools/dht/tests.py): node x, asked for target t, answers with k
+nodes drawn from the prefix block sharing ``commonBits(x, t) + 1``
+leading bits with t — exactly what x's deepest relevant k-bucket holds
+in a converged Kademlia network (every hop gains ≥ 1 prefix bit, ~3 in
+expectation with k = 8 samples).  When that block is smaller than k the
+reply falls back to t's immediate sorted neighborhood (a real peer that
+close knows the target's neighbors).  Replies are deterministic in
+(seed, round, search, slot) via a counter-based hash, so runs are
+reproducible and shardable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.ids import N_LIMBS, ID_BITS, xor_ids, common_bits, ids_to_bytes
+from ..ops.radix import _PREFIX_MASKS
+from ..ops.sorted_table import _lower_bound
+
+_U32 = jnp.uint32
+
+ALPHA = 4            # in-flight requests per search (dht.h:321)
+SEARCH_NODES = 14    # candidate set size (dht.h:308)
+TARGET_NODES = 8     # convergence set (routing_table.h:26)
+
+
+def _mix32(x):
+    """Counter-based uint32 hash (splitmix-style) for reply sampling."""
+    x = x.astype(_U32)
+    x = x ^ (x >> 16)
+    x = x * _U32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * _U32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _increment(ids):
+    """160-bit +1 over [..., 5] uint32 limbs (wraps to zero)."""
+    out = []
+    carry = jnp.ones(ids.shape[:-1], dtype=_U32)
+    for i in range(N_LIMBS - 1, -1, -1):
+        s = ids[..., i] + carry
+        carry = jnp.where((s == 0) & (carry == 1), _U32(1), _U32(0))
+        out.append(s)
+    return jnp.stack(out[::-1], axis=-1)
+
+
+def _prefix_block_bounds(sorted_ids, n, targets, prefix_len):
+    """[lo, ub) sorted-index range of ids sharing `prefix_len` leading bits
+    with each target.  targets [..., 5]; prefix_len [...] int32."""
+    masks = jnp.take(jnp.asarray(_PREFIX_MASKS),
+                     jnp.clip(prefix_len, 0, ID_BITS), axis=0)
+    p_lo = targets & masks
+    p_hi = p_lo | ~masks
+    flat_lo = p_lo.reshape(-1, N_LIMBS)
+    flat_hi = _increment(p_hi).reshape(-1, N_LIMBS)
+    lo = _lower_bound(sorted_ids, flat_lo, n).reshape(targets.shape[:-1])
+    ub = _lower_bound(sorted_ids, flat_hi, n).reshape(targets.shape[:-1])
+    # p_hi of all-ones wraps to zero on increment → block extends to n
+    wrapped = jnp.all(_increment(p_hi) == 0, axis=-1)
+    ub = jnp.where(wrapped, n, ub)
+    return lo, ub
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "alpha", "search_nodes", "max_hops"),
+)
+def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
+                     k: int = TARGET_NODES, alpha: int = ALPHA,
+                     search_nodes: int = SEARCH_NODES, max_hops: int = 48):
+    """Run Q iterative lookups to convergence against an N-node network.
+
+    Args:
+      sorted_ids: uint32 [N, 5], lexicographically sorted network ids
+                  (node identity == sorted row index).
+      n_valid:    number of real rows in sorted_ids.
+      targets:    uint32 [Q, 5] lookup keys.
+
+    Returns dict of:
+      nodes     [Q, k] int32  — the k closest nodes found (sorted rows)
+      dist      [Q, k, 5]     — their XOR distances
+      hops      [Q] int32     — rounds until the first-k set had replied
+      converged [Q] bool
+    """
+    N = sorted_ids.shape[0]
+    Q = targets.shape[0]
+    S = search_nodes
+    R = alpha * k            # reply entries merged per round
+    n = jnp.asarray(n_valid, jnp.int32)
+    seed_u = jnp.asarray(seed, dtype=jnp.int32).astype(_U32)
+
+    pos_t = _lower_bound(sorted_ids, targets, n)      # [Q], for fallback replies
+
+    def reply_gather(x_rows, round_no):
+        """Simulated answers of the α queried nodes per search.
+        x_rows [Q, alpha] int32 (−1 = no request) → node rows [Q, R]."""
+        x_ids = jnp.take(sorted_ids, jnp.clip(x_rows, 0, N - 1), axis=0)  # [Q,a,5]
+        b = common_bits(x_ids, targets[:, None, :])                        # [Q,a]
+        prefix_len = jnp.clip(b + 1, 0, ID_BITS)
+        lo, ub = _prefix_block_bounds(sorted_ids, n, targets[:, None, :]
+                                      .repeat(x_rows.shape[1], 1), prefix_len)
+        size = jnp.maximum(ub - lo, 0)                                     # [Q,a]
+
+        qi = jnp.arange(Q, dtype=_U32)[:, None, None]
+        ai = jnp.arange(x_rows.shape[1], dtype=_U32)[None, :, None]
+        ji = jnp.arange(k, dtype=_U32)[None, None, :]
+        ctr = (((round_no.astype(_U32) * _U32(Q) + qi) * _U32(alpha) + ai)
+               * _U32(k) + ji) ^ seed_u
+        h = _mix32(ctr)                                                     # [Q,a,k]
+
+        blk = lo[..., None] + (h % jnp.maximum(size[..., None], 1).astype(_U32)
+                               ).astype(jnp.int32)
+        # fallback: block too small → sample the target's sorted
+        # neighborhood (an (alpha·k)-wide window clipped to the table);
+        # per-round hashes make successive rounds cover the whole window
+        wlo = jnp.clip(pos_t[:, None, None] - R // 2, 0, jnp.maximum(n - 1, 0))
+        whi = jnp.clip(pos_t[:, None, None] + R // 2, 1, n)
+        wsize = jnp.maximum(whi - wlo, 1)
+        fb = wlo + (h % wsize.astype(_U32)).astype(jnp.int32)
+        rows = jnp.where((size[..., None] >= k), blk, fb)
+        rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
+        return rows.reshape(Q, R)
+
+    def merge(cand_node, cand_dist, queried, new_rows):
+        """Insert replies, dedupe by node, keep the S closest
+        (↔ Search::insertNode, src/search.h:636-722)."""
+        new_ids = jnp.take(sorted_ids, jnp.clip(new_rows, 0, N - 1), axis=0)
+        new_dist = xor_ids(targets[:, None, :], new_ids)
+        node = jnp.concatenate([cand_node, new_rows], axis=1)          # [Q,S+R]
+        dist = jnp.concatenate([cand_dist, new_dist], axis=1)
+        qd = jnp.concatenate([queried, jnp.zeros((Q, R), jnp.int32)], axis=1)
+        inv = (node < 0).astype(jnp.int32)
+        # sort by (invalid, dist, node, not-queried) so that among
+        # duplicates of a node the already-queried copy comes first
+        out = lax.sort(
+            (inv, dist[..., 0], dist[..., 1], dist[..., 2], dist[..., 3],
+             dist[..., 4], node, 1 - qd),
+            dimension=1, num_keys=8,
+        )
+        inv_s, node_s = out[0], out[6]
+        dist_s = jnp.stack(out[1:6], axis=-1)
+        qd_s = 1 - out[7]
+        # dedupe: same node appears adjacently (same dist); drop repeats
+        dup = jnp.concatenate(
+            [jnp.zeros((Q, 1), bool),
+             (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)], axis=1)
+        inv2 = jnp.where(dup, 1, inv_s)
+        out2 = lax.sort(
+            (inv2, dist_s[..., 0], dist_s[..., 1], dist_s[..., 2],
+             dist_s[..., 3], dist_s[..., 4], node_s, 1 - qd_s),
+            dimension=1, num_keys=7,
+        )
+        present = out2[0][:, :S] == 0
+        node_f = jnp.where(present, out2[6][:, :S], -1)
+        dist_f = jnp.where(present[..., None],
+                           jnp.stack(out2[1:6], axis=-1)[:, :S],
+                           jnp.uint32(0xFFFFFFFF))
+        qd_f = (1 - out2[7])[:, :S] * present
+        return node_f, dist_f, qd_f
+
+    # -- bootstrap: cold start from ONE pseudo-random bootstrap peer per
+    # search (like a node boots from a single well-known host) ------------
+    empty = n <= 0
+    boot = jnp.full((Q, alpha), -1, jnp.int32).at[:, 0].set(
+        jnp.where(
+            empty, -1,
+            (_mix32(jnp.arange(Q, dtype=_U32) ^ seed_u)
+             % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32)))
+    cand_node = jnp.full((Q, S), -1, jnp.int32)
+    cand_dist = jnp.full((Q, S, N_LIMBS), 0xFFFFFFFF, _U32)
+    queried = jnp.zeros((Q, S), jnp.int32)
+    first = reply_gather(boot, jnp.int32(0))
+    cand_node, cand_dist, queried = merge(cand_node, cand_dist, queried, first)
+
+    def synced(cand_node, queried):
+        """First min(k, #candidates) candidates all answered
+        (↔ isSynced, search.h:734-747).  Replies are instantaneous in this
+        network model, so 'queried' doubles as 'replied'; a lossy-network
+        model would split the two flags again."""
+        present = cand_node[:, :k] >= 0
+        return jnp.all(~present | (queried[:, :k] > 0), axis=1) & \
+            jnp.any(present, axis=1)
+
+    def cond(state):
+        _, _, _, _, done, round_no = state
+        return (~jnp.all(done)) & (round_no < max_hops)
+
+    def body(state):
+        cand_node, cand_dist, queried, hops, done, round_no = state
+        # select the closest α unqueried candidates per active search
+        # (↔ searchSendGetValues picking SearchNodes with canGet,
+        #  src/dht.cpp:628-639)
+        can = (cand_node >= 0) & (queried == 0) & ~done[:, None]
+        rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+        sel = can & (rank <= alpha)
+        # gather selected rows into [Q, alpha] (−1 pad)
+        sel_rank = jnp.where(sel, rank - 1, S)
+        x_rows = jnp.full((Q, alpha + 1), -1, jnp.int32)
+        x_rows = x_rows.at[
+            jnp.arange(Q)[:, None].repeat(S, 1).reshape(-1),
+            jnp.minimum(sel_rank, alpha).reshape(-1),
+        ].max(jnp.where(sel, cand_node, -1).reshape(-1))
+        x_rows = x_rows[:, :alpha]
+
+        new_rows = reply_gather(x_rows, round_no + 1)
+        queried = jnp.where(sel, 1, queried)
+        cand_node, cand_dist, queried = merge(
+            cand_node, cand_dist, queried, new_rows)
+
+        now_done = synced(cand_node, queried)
+        stalled = ~jnp.any((cand_node >= 0) & (queried == 0), axis=1)
+        sent = jnp.any(sel, axis=1)
+        # a stalling round sends nothing → costs no hop (matches the
+        # scalar reference's stall return path)
+        hops = jnp.where(~done & sent, hops + 1, hops)
+        done = done | now_done | stalled
+        return cand_node, cand_dist, queried, hops, done, round_no + 1
+
+    state = (cand_node, cand_dist, queried,
+             jnp.zeros((Q,), jnp.int32),
+             synced(cand_node, queried) | empty,
+             jnp.int32(0))
+    cand_node, cand_dist, queried, hops, done, _ = \
+        lax.while_loop(cond, body, state)
+
+    return {
+        "nodes": cand_node[:, :k],
+        "dist": cand_dist[:, :k],
+        "hops": hops,
+        "converged": synced(cand_node, queried) & ~empty,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Scalar reference implementation (oracle for hop-count parity and the CPU
+# baseline) — same network model, sequential python, one lookup at a time,
+# mirroring the shape of the reference's searchStep loop.
+# ---------------------------------------------------------------------------
+
+def scalar_lookup(sorted_ids_np: np.ndarray, n: int, target_np: np.ndarray,
+                  *, seed: int = 0, k: int = TARGET_NODES, alpha: int = ALPHA,
+                  search_nodes: int = SEARCH_NODES, max_hops: int = 48,
+                  rng=None):
+    """Sequential lookup with the same candidate-set/α/convergence
+    semantics and the same network reply model as simulate_lookups (reply
+    sampling is random rather than counter-hashed, so parity is
+    statistical, not bitwise).  Returns (nodes, hops, converged)."""
+    if rng is None:
+        rng = np.random.default_rng(seed)
+
+    def row_int(i):
+        return int.from_bytes(ids_to_bytes(sorted_ids_np[i]).tobytes(), "big")
+
+    t_int = int.from_bytes(ids_to_bytes(target_np).tobytes(), "big")
+
+    def lower_bound(v: int) -> int:
+        lo, hi = 0, n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if row_int(mid) < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    pos_t = lower_bound(t_int)
+
+    def reply(x_row: int) -> list:
+        x_int = row_int(x_row)
+        cb = 160 - (x_int ^ t_int).bit_length() if x_int != t_int else 160
+        plen = min(cb + 1, 160)
+        mask = ((1 << plen) - 1) << (160 - plen) if plen else 0
+        p_lo = t_int & mask
+        p_hi = p_lo | ((1 << (160 - plen)) - 1)
+        lo = lower_bound(p_lo)
+        ub = lower_bound(p_hi + 1)
+        size = ub - lo
+        if size >= k:
+            return [lo + int(v) for v in rng.integers(0, size, k)]
+        R = alpha * k
+        wlo = max(pos_t - R // 2, 0)
+        whi = min(pos_t + R // 2, n)
+        return [wlo + int(v) for v in rng.integers(0, max(whi - wlo, 1), k)]
+
+    # candidate set: list of (dist, row, queried, replied)
+    cands: dict[int, list] = {}
+
+    def insert(row):
+        if row in cands:
+            return
+        cands[row] = [row_int(row) ^ t_int, row, False, False]
+
+    boot = int(rng.integers(0, n))
+    for r in reply(boot):
+        insert(r)
+
+    hops = 0
+    while hops < max_hops:
+        ordered = sorted(cands.values())[:search_nodes]
+        cands = {c[1]: c for c in ordered}
+        topk = ordered[:k]
+        if topk and all(c[3] for c in topk):
+            return [c[1] for c in topk], hops, True
+        to_query = [c for c in ordered if not c[2]][:alpha]
+        if not to_query:
+            return [c[1] for c in topk], hops, False
+        hops += 1
+        for c in to_query:
+            c[2] = c[3] = True
+            for r in reply(c[1]):
+                insert(r)
+    ordered = sorted(cands.values())[:k]
+    return [c[1] for c in ordered], hops, False

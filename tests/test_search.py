@@ -1,0 +1,106 @@
+"""Batched iterative lookup engine tests: convergence, exactness of the
+found set, determinism, and hop-count parity with the scalar reference
+port (model of the reference's searchStep loop, src/dht.cpp:561-654)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from opendht_tpu.ops import ids as K
+from opendht_tpu.ops.sorted_table import sort_table
+from opendht_tpu.ops.xor_topk import xor_topk
+from opendht_tpu.core.search import simulate_lookups, scalar_lookup
+
+
+def _network(n, seed):
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 256, (n, 20), dtype=np.uint8)
+    ids = jnp.asarray(K.ids_from_bytes(raw))
+    sorted_ids, _, n_valid = sort_table(ids)
+    return sorted_ids, n_valid
+
+
+def test_lookups_converge_and_find_closest():
+    sorted_ids, n = _network(4000, 0)
+    rng = np.random.default_rng(1)
+    q_raw = rng.integers(0, 256, (64, 20), dtype=np.uint8)
+    targets = jnp.asarray(K.ids_from_bytes(q_raw))
+    out = simulate_lookups(sorted_ids, n, targets, seed=7)
+    conv = np.asarray(out["converged"])
+    hops = np.asarray(out["hops"])
+    nodes = np.asarray(out["nodes"])
+    assert conv.all()
+    assert (hops >= 1).all() and (hops <= 30).all()
+
+    # the found set must match the true global top-8 closely
+    true_dist, true_idx = xor_topk(targets, sorted_ids, k=8)
+    true_idx = np.asarray(true_idx)
+    recall = np.mean([
+        len(set(nodes[i]) & set(true_idx[i])) / 8 for i in range(64)
+    ])
+    assert recall >= 0.95, recall
+
+
+def test_lookup_deterministic():
+    sorted_ids, n = _network(1000, 2)
+    rng = np.random.default_rng(3)
+    targets = jnp.asarray(K.ids_from_bytes(
+        rng.integers(0, 256, (16, 20), dtype=np.uint8)))
+    a = simulate_lookups(sorted_ids, n, targets, seed=42)
+    b = simulate_lookups(sorted_ids, n, targets, seed=42)
+    np.testing.assert_array_equal(np.asarray(a["nodes"]), np.asarray(b["nodes"]))
+    np.testing.assert_array_equal(np.asarray(a["hops"]), np.asarray(b["hops"]))
+    c = simulate_lookups(sorted_ids, n, targets, seed=43)
+    assert not np.array_equal(np.asarray(a["hops"]), np.asarray(c["hops"]))
+
+
+def test_tiny_network():
+    sorted_ids, n = _network(5, 4)
+    rng = np.random.default_rng(5)
+    targets = jnp.asarray(K.ids_from_bytes(
+        rng.integers(0, 256, (8, 20), dtype=np.uint8)))
+    out = simulate_lookups(sorted_ids, n, targets, seed=1)
+    nodes = np.asarray(out["nodes"])
+    # every real node should be found; padding is -1
+    for row in nodes:
+        assert set(row[row >= 0]) == {0, 1, 2, 3, 4}
+
+
+def test_hop_parity_with_scalar_reference():
+    sorted_ids, n = _network(5000, 6)
+    ids_np = np.asarray(sorted_ids)
+    n_int = int(n)
+    rng = np.random.default_rng(7)
+    q_raw = rng.integers(0, 256, (48, 20), dtype=np.uint8)
+    targets = jnp.asarray(K.ids_from_bytes(q_raw))
+
+    out = simulate_lookups(sorted_ids, n, targets, seed=8)
+    hops_batched = np.asarray(out["hops"])
+
+    hops_scalar = []
+    for i in range(48):
+        _, h, conv = scalar_lookup(ids_np, n_int, np.asarray(targets[i]),
+                                   rng=np.random.default_rng(100 + i))
+        assert conv
+        hops_scalar.append(h)
+    hops_scalar = np.array(hops_scalar)
+
+    # same convergence law → medians within 2 rounds of each other
+    assert abs(np.median(hops_batched) - np.median(hops_scalar)) <= 2, (
+        np.median(hops_batched), np.median(hops_scalar))
+
+
+def test_scaling_hops_grow_logarithmically():
+    m1 = []
+    for nsize, seed in ((500, 8), (8000, 9)):
+        sorted_ids, n = _network(nsize, seed)
+        rng = np.random.default_rng(seed)
+        targets = jnp.asarray(K.ids_from_bytes(
+            rng.integers(0, 256, (32, 20), dtype=np.uint8)))
+        out = simulate_lookups(sorted_ids, n, targets, seed=seed)
+        assert np.asarray(out["converged"]).all()
+        m1.append(np.median(np.asarray(out["hops"])))
+    # bigger network needs ≥ as many hops, but only logarithmically more
+    assert m1[1] >= m1[0]
+    assert m1[1] - m1[0] <= 6
