@@ -43,16 +43,14 @@ from .ids import N_LIMBS, xor_ids
 _U32 = jnp.uint32
 
 
-def _merge_topk(best_dist, best_idx, best_inv, cand_dist, cand_idx, cand_inv, k):
-    """Merge running top-k with tile candidates via one lexicographic sort.
+def select_topk(dist, idx, inv, k):
+    """Top-k rows of [Q, C] candidates via one lexicographic sort.
 
-    Shapes: best_* [Q, k(, 5)], cand_* [Q, T(, 5)].  Sort keys, in order:
-    invalid flag (valid first), 5 distance limbs (ascending = closest
-    first), then table index (deterministic tie-break).
+    Sort keys, in order: invalid flag (valid first), 5 distance limbs
+    (ascending = closest first), then table index (deterministic
+    tie-break).  Returns (dist [Q,k,5], idx [Q,k], inv [Q,k]), unmasked —
+    apply :func:`mask_invalid` at the output boundary.
     """
-    dist = jnp.concatenate([best_dist, cand_dist], axis=1)
-    idx = jnp.concatenate([best_idx, cand_idx], axis=1)
-    inv = jnp.concatenate([best_inv, cand_inv], axis=1)
     operands = (
         inv,
         dist[..., 0], dist[..., 1], dist[..., 2], dist[..., 3], dist[..., 4],
@@ -63,6 +61,22 @@ def _merge_topk(best_dist, best_idx, best_inv, cand_dist, cand_idx, cand_inv, k)
     new_dist = jnp.stack(sorted_ops[1:6], axis=-1)[:, :k]
     new_idx = sorted_ops[6][:, :k]
     return new_dist, new_idx, new_inv
+
+
+def mask_invalid(dist, idx, inv):
+    """Canonical sentinels on invalid rows: idx → -1, dist → all-ones."""
+    idx = jnp.where(inv == 0, idx, -1)
+    dist = jnp.where((inv == 0)[..., None], dist,
+                     jnp.full_like(dist, 0xFFFFFFFF))
+    return dist, idx
+
+
+def _merge_topk(best_dist, best_idx, best_inv, cand_dist, cand_idx, cand_inv, k):
+    """Merge running top-k with tile candidates via one lexicographic sort."""
+    dist = jnp.concatenate([best_dist, cand_dist], axis=1)
+    idx = jnp.concatenate([best_idx, cand_idx], axis=1)
+    inv = jnp.concatenate([best_inv, cand_inv], axis=1)
+    return select_topk(dist, idx, inv, k)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "tile"))
@@ -121,10 +135,7 @@ def xor_topk(queries, table, *, k: int = 8, tile: int = 4096, valid=None):
         (init_dist, init_idx, init_inv),
         (table_t, valid_t, jnp.arange(n_tiles, dtype=jnp.int32)),
     )
-    best_idx = jnp.where(best_inv == 0, best_idx, -1)
-    best_dist = jnp.where(
-        (best_inv == 0)[..., None], best_dist, jnp.full_like(best_dist, 0xFFFFFFFF)
-    )
+    best_dist, best_idx = mask_invalid(best_dist, best_idx, best_inv)
     return best_dist, best_idx
 
 

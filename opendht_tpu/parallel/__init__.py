@@ -1,0 +1,9 @@
+"""Multi-chip scale-out: sharded node tables + collective top-k merge."""
+
+from .sharded import (  # noqa: F401
+    make_mesh,
+    pad_to_multiple,
+    sharded_xor_topk,
+    sharded_lookup,
+    dp_simulate_lookups,
+)
