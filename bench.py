@@ -82,17 +82,16 @@ def main():
     exact = bool(np.array_equal(np.asarray(d_win), np.asarray(d_ref)))
 
     # scalar CPU baseline on the same sorted table
-    h = np.asarray(sorted_ids).astype(np.uint64)
-    sorted_ints = (
-        (h[:, 0].astype(object) << 128) | (h[:, 1].astype(object) << 96)
-        | (h[:, 2].astype(object) << 64) | (h[:, 3].astype(object) << 32)
-        | h[:, 4].astype(object)
-    ).tolist()
-    qh = np.asarray(queries[:64]).astype(np.uint64)
-    q_ints = [
-        (int(r[0]) << 128) | (int(r[1]) << 96) | (int(r[2]) << 64)
-        | (int(r[3]) << 32) | int(r[4]) for r in qh
-    ]
+    def pack160(rows):
+        """uint32[...,5] limb rows (big-endian limb order) → python ints."""
+        return [
+            (int(r[0]) << 128) | (int(r[1]) << 96) | (int(r[2]) << 64)
+            | (int(r[3]) << 32) | int(r[4])
+            for r in np.asarray(rows)
+        ]
+
+    sorted_ints = pack160(sorted_ids)
+    q_ints = pack160(queries[:64])
     t0 = time.perf_counter()
     for q in q_ints:
         scalar_closest(sorted_ints, q, K)

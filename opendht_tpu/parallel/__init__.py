@@ -4,6 +4,8 @@ from .sharded import (  # noqa: F401
     make_mesh,
     pad_to_multiple,
     sharded_xor_topk,
+    sharded_sort_table,
+    sharded_window_lookup,
     sharded_lookup,
     dp_simulate_lookups,
 )

@@ -131,13 +131,42 @@ def sharded_xor_topk(mesh: Mesh, queries, table, *, k: int = 8,
               jnp.asarray(valid))
 
 
+@functools.lru_cache(maxsize=8)
+def _build_sharded_sort(mesh: Mesh):
+    def local(tbl, val):
+        sorted_ids, perm, n_valid = sort_table(tbl, val)
+        return sorted_ids, perm, jnp.asarray(n_valid, jnp.int32)[None]
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("t", None), P("t")),
+        out_specs=(P("t", None), P("t"), P("t")),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_sort_table(mesh: Mesh, table, valid=None):
+    """Sort each table shard locally (rows stay on their device; no
+    collectives).  Returns (sorted_ids [N,5], perm [N], n_valid [n_t]) —
+    all sharded over ``t`` — to feed repeated
+    :func:`sharded_window_lookup` calls, so a stable table is sorted once
+    and amortized across query batches (mirroring the single-device
+    sort_table / window_topk split in ops/sorted_table.py)."""
+    N = table.shape[0]
+    if valid is None:
+        valid = jnp.ones((N,), dtype=bool)
+    fn = _build_sharded_sort(mesh)
+    return fn(jnp.asarray(table, _U32), jnp.asarray(valid))
+
+
 @functools.lru_cache(maxsize=64)
-def _build_sharded_lookup(mesh: Mesh, k: int, window: int, shard_n: int):
+def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int, shard_n: int):
     n_t = mesh.shape["t"]
 
-    def local(q, tbl, val):
+    def local(q, sorted_ids, perm, n_valid_shard):
         ti = lax.axis_index("t")
-        sorted_ids, perm, n_valid = sort_table(tbl, val)
+        n_valid = n_valid_shard[0]
         dist, sidx, cert = window_topk(sorted_ids, n_valid, q, k=k,
                                        window=window)
 
@@ -164,32 +193,42 @@ def _build_sharded_lookup(mesh: Mesh, k: int, window: int, shard_n: int):
 
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("q", None), P("t", None), P("t")),
+        in_specs=(P("q", None), P("t", None), P("t"), P("t")),
         out_specs=(P("q", None, None), P("q", None)),
         check_vma=False,
     )
     return jax.jit(fn)
 
 
-def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
-                   window: int = 128, valid=None):
-    """Exact k XOR-closest over a row-sharded table — sorted-window fast
-    path.  Each shard sorts its rows (once per compiled call), answers
-    with its local window top-k (per-query exactness certificate;
-    uncertified batches fall back to the shard-local full scan), then the
-    per-shard winners are all_gather-merged over ``t``.
+def sharded_window_lookup(mesh: Mesh, queries, sorted_ids, perm, n_valid, *,
+                          k: int = 8, window: int = 128):
+    """Exact k XOR-closest over a pre-sorted row-sharded table — the
+    repeated-lookup fast path.  Takes the output of
+    :func:`sharded_sort_table`; each shard answers with its local window
+    top-k (per-query exactness certificate; uncertified batches fall back
+    to the shard-local full scan), then the per-shard winners are
+    all_gather-merged over ``t``.
 
     Same contract as :func:`sharded_xor_topk`: returns
     (dist [Q, k, 5], idx [Q, k]) where idx are **global original-table
     row indices** (-1 padding), sharded over ``q``.
     """
-    N = table.shape[0]
+    N = sorted_ids.shape[0]
     shard_n = N // mesh.shape["t"]
-    if valid is None:
-        valid = jnp.ones((N,), dtype=bool)
-    fn = _build_sharded_lookup(mesh, k, min(window, shard_n), shard_n)
-    return fn(jnp.asarray(queries, _U32), jnp.asarray(table, _U32),
-              jnp.asarray(valid))
+    fn = _build_sharded_window_lookup(mesh, k, min(window, shard_n), shard_n)
+    return fn(jnp.asarray(queries, _U32), jnp.asarray(sorted_ids, _U32),
+              jnp.asarray(perm, jnp.int32), jnp.asarray(n_valid, jnp.int32))
+
+
+def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
+                   window: int = 128, valid=None):
+    """One-shot convenience: :func:`sharded_sort_table` +
+    :func:`sharded_window_lookup`.  Callers with a stable table and many
+    query batches should hold the sorted form and call
+    ``sharded_window_lookup`` directly to amortize the sort."""
+    sorted_ids, perm, n_valid = sharded_sort_table(mesh, table, valid)
+    return sharded_window_lookup(mesh, queries, sorted_ids, perm, n_valid,
+                                 k=k, window=window)
 
 
 def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):

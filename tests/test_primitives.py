@@ -1,0 +1,119 @@
+"""Unit tests for the L0 host primitives: Scheduler, RateLimiter, SockAddr,
+utils.  Mirrors the reference's implicit contracts (scheduler.h,
+rate_limiter.h, sockaddr.h)."""
+
+import math
+
+from opendht_tpu.rate_limiter import RateLimiter
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+from opendht_tpu.utils import TIME_MAX, pack_msg, unpack_msg
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------- scheduler
+def test_scheduler_runs_due_jobs_in_order():
+    clk = FakeClock()
+    s = Scheduler(clock=clk)
+    order = []
+    s.add(2.0, lambda: order.append("b"))
+    s.add(1.0, lambda: order.append("a"))
+    s.add(5.0, lambda: order.append("later"))
+    clk.t = 3.0
+    nxt = s.run()
+    assert order == ["a", "b"]
+    assert nxt == 5.0
+
+
+def test_scheduler_cancel_and_edit():
+    clk = FakeClock()
+    s = Scheduler(clock=clk)
+    hits = []
+    j1 = s.add(1.0, lambda: hits.append(1))
+    j2 = s.add(1.0, lambda: hits.append(2))
+    j1.cancel()
+    j2 = s.edit(j2, 10.0)
+    clk.t = 2.0
+    assert s.run() == 10.0
+    assert hits == []
+    clk.t = 10.0
+    s.run()
+    assert hits == [2]
+
+
+def test_scheduler_self_reschedule_no_starvation():
+    # a job that reschedules itself for "now" must not loop forever in run()
+    clk = FakeClock()
+    s = Scheduler(clock=clk)
+    count = []
+
+    def tick():
+        count.append(1)
+        s.add(s.time(), tick)
+
+    s.add(0.0, tick)
+    clk.t = 0.0
+    s.run()
+    assert len(count) == 1  # the re-added job waits for the next run
+
+
+def test_scheduler_time_max_parks_job():
+    s = Scheduler(clock=FakeClock())
+    s.add(TIME_MAX, lambda: None)
+    assert s.next_job_time() == TIME_MAX
+
+
+# -------------------------------------------------------------- rate limiter
+def test_rate_limiter_quota_and_window():
+    rl = RateLimiter(quota=3, period=1.0)
+    assert all(rl.limit(0.0) for _ in range(3))
+    assert not rl.limit(0.5)      # quota spent inside window
+    assert rl.limit(1.5)          # old records aged out
+    assert rl.maintain(10.0) == 0
+    assert rl.empty()
+
+
+# ------------------------------------------------------------------ sockaddr
+def test_sockaddr_basics():
+    a = SockAddr("127.0.0.1", 4222)
+    assert a.family == __import__("socket").AF_INET
+    assert a.port == 4222 and a.is_loopback() and not a.is_global()
+    b = SockAddr("::1", 4222)
+    assert b.family == __import__("socket").AF_INET6 and b.is_loopback()
+    assert SockAddr().family == __import__("socket").AF_UNSPEC
+    assert not SockAddr()
+
+
+def test_sockaddr_compact_roundtrip():
+    for host, port, ln in [("192.168.1.7", 8080, 6), ("2001:db8::42", 443, 18)]:
+        a = SockAddr(host, port)
+        c = a.to_compact()
+        assert len(c) == ln
+        assert SockAddr.from_compact(c) == a
+
+
+def test_sockaddr_ip_cmp_ignores_port():
+    a = SockAddr("10.0.0.1", 1)
+    b = SockAddr("10.0.0.1", 2)
+    c = SockAddr("10.0.0.2", 1)
+    assert a.ip_cmp(b) == 0 and a != b
+    assert a.ip_cmp(c) < 0 and c.ip_cmp(a) > 0
+    assert a.is_private()
+
+
+def test_sockaddr_ordering_v4_before_v6():
+    assert SockAddr("255.255.255.255", 1) < SockAddr("::", 1)
+
+
+# --------------------------------------------------------------------- utils
+def test_msgpack_helpers_roundtrip():
+    obj = {"a": 1, "b": b"\x00\xff", "s": "héllo", "l": [1, 2, 3]}
+    assert unpack_msg(pack_msg(obj)) == obj
+    assert math.isinf(TIME_MAX)

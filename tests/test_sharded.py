@@ -16,7 +16,7 @@ from opendht_tpu.ops.sorted_table import sort_table
 from opendht_tpu.core.search import simulate_lookups
 from opendht_tpu.parallel import (
     make_mesh, pad_to_multiple, sharded_xor_topk, sharded_lookup,
-    dp_simulate_lookups,
+    sharded_sort_table, sharded_window_lookup, dp_simulate_lookups,
 )
 
 
@@ -65,7 +65,7 @@ def test_sharded_xor_topk_padded_table(mesh):
     """Tables whose row count isn't divisible by n_t are padded with
     invalid rows; results must be unchanged."""
     rng = np.random.default_rng(9)
-    table = _rand_ids(rng, 300)
+    table = _rand_ids(rng, 301)   # not divisible by n_t=4 ⇒ real padding
     queries = _rand_ids(rng, 4 * mesh.shape["q"])
 
     d_ref, i_ref = xor_topk(jnp.asarray(queries), jnp.asarray(table), k=8)
@@ -90,6 +90,21 @@ def test_sharded_window_lookup_matches_full_scan(mesh):
     d_sh, rows_sh = sharded_lookup(mesh, queries, table, k=8, window=64)
     np.testing.assert_array_equal(np.asarray(d_sh), np.asarray(d_ref))
     np.testing.assert_array_equal(np.asarray(rows_sh), np.asarray(i_ref))
+
+
+def test_sharded_sort_once_lookup_many(mesh):
+    """The two-step API (sort once, look up many batches) matches the
+    full-scan oracle for every batch — the amortized production path."""
+    rng = np.random.default_rng(12)
+    table = _rand_ids(rng, 512)
+    sorted_ids, perm, n_valid = sharded_sort_table(mesh, table)
+    for batch in range(3):
+        queries = _rand_ids(rng, 8 * mesh.shape["q"])
+        d_ref, i_ref = xor_topk(jnp.asarray(queries), jnp.asarray(table), k=8)
+        d_sh, rows = sharded_window_lookup(mesh, queries, sorted_ids, perm,
+                                           n_valid, k=8, window=64)
+        np.testing.assert_array_equal(np.asarray(d_sh), np.asarray(d_ref))
+        np.testing.assert_array_equal(np.asarray(rows), np.asarray(i_ref))
 
 
 def test_dp_simulate_matches_unsharded(mesh):
