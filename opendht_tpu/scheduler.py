@@ -83,11 +83,19 @@ class Scheduler:
         # for the same guarantee, scheduler.h:90-95).
         due = []
         while heap and heap[0][0] <= self._now:
-            due.append(heapq.heappop(heap)[2])
-        for job in due:
-            func = job.func
-            if func is not None:
-                func()
+            t, _, job = heapq.heappop(heap)
+            due.append((t, job))
+        try:
+            while due:
+                _, job = due.pop(0)
+                func = job.func
+                if func is not None:
+                    func()
+        finally:
+            # If a job raised, the not-yet-run due jobs go back on the
+            # heap instead of being silently lost with the local list.
+            for t, job in due:
+                heapq.heappush(heap, (t, next(self._seq), job))
         return self.next_job_time()
 
     def next_job_time(self) -> float:

@@ -64,6 +64,26 @@ def test_scheduler_self_reschedule_no_starvation():
     assert len(count) == 1  # the re-added job waits for the next run
 
 
+def test_scheduler_raising_job_does_not_lose_others():
+    clk = FakeClock()
+    s = Scheduler(clock=clk)
+    hits = []
+
+    def boom():
+        raise RuntimeError("job failed")
+
+    s.add(1.0, boom)
+    s.add(1.0, lambda: hits.append("survivor"))
+    clk.t = 2.0
+    try:
+        s.run()
+    except RuntimeError:
+        pass
+    # the not-yet-run due job went back on the heap, not into the void
+    s.run()
+    assert hits == ["survivor"]
+
+
 def test_scheduler_time_max_parks_job():
     s = Scheduler(clock=FakeClock())
     s.add(TIME_MAX, lambda: None)

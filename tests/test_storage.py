@@ -1,0 +1,245 @@
+"""Storage-layer tests: Storage refresh-or-insert/expire/quota buckets,
+ValueCache add/refresh/expire semantics, OpCache/SearchCache listen dedup
+(reference contracts: src/storage.h, value_cache.h, op_cache.{h,cpp})."""
+
+from opendht_tpu.core.op_cache import OpCache, OpValueCache, SearchCache, OP_LINGER
+from opendht_tpu.core.storage import (
+    MAX_VALUES, NODE_EXPIRE_TIME, Storage, StorageBucket,
+)
+from opendht_tpu.core.listener import Listener
+from opendht_tpu.core.value import Query, TypeStore, Value, ValueType
+from opendht_tpu.core.value_cache import ValueCache
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.utils import TIME_MAX
+
+KEY = InfoHash.get("key")
+
+
+def val(vid, data=b"x", type_id=0):
+    return Value(data, value_id=vid, type_id=type_id)
+
+
+# ------------------------------------------------------------------- Storage
+def test_store_insert_and_refresh():
+    st = Storage()
+    v1 = val(1, b"aaaa")
+    slot, d = st.store(KEY, v1, created=10.0, expiration=100.0)
+    assert slot is not None and d.values_diff == 1 and d.size_diff == 4
+    assert st.total_size == 4 and st.value_count() == 1
+
+    # same object again: pure refresh, no change reported
+    slot2, d2 = st.store(KEY, v1, created=20.0, expiration=100.0)
+    assert slot2 is None and d2.values_diff == 0 and d2.size_diff == 0
+    assert st.values[0].created == 20.0
+
+    # same id, new object: replace, size diff reported
+    v1b = val(1, b"aaaaaaaa")
+    slot3, d3 = st.store(KEY, v1b, created=30.0, expiration=200.0)
+    assert slot3 is not None and d3.size_diff == 4 and d3.values_diff == 0
+    assert st.get_by_id(1).data == b"aaaaaaaa" and st.total_size == 8
+
+
+def test_store_cap():
+    st = Storage()
+    for i in range(MAX_VALUES):
+        st.store(KEY, val(i + 1), 0.0, 100.0)
+    slot, d = st.store(KEY, val(MAX_VALUES + 1), 0.0, 100.0)
+    assert slot is None and d.values_diff == 0
+    assert st.value_count() == MAX_VALUES
+
+
+def test_expire_partitions_and_notifies():
+    st = Storage()
+    st.store(KEY, val(1, b"aa"), 0.0, 50.0)
+    st.store(KEY, val(2, b"bbb"), 0.0, 150.0)
+    size_diff, expired = st.expire(KEY, now=100.0)
+    assert size_diff == -2
+    assert [v.id for v in expired] == [1]
+    assert st.value_count() == 1 and st.total_size == 3
+
+
+def test_expire_drops_stale_remote_listeners():
+    st = Storage()
+    node = object()
+    st.listeners[node] = {1: Listener(0.0, Query()), 2: Listener(90.0, Query())}
+    st.expire(KEY, now=NODE_EXPIRE_TIME + 50.0)
+    assert list(st.listeners[node]) == [2]
+    st.expire(KEY, now=NODE_EXPIRE_TIME + 200.0)
+    assert node not in st.listeners
+
+
+def test_remove_and_clear():
+    st = Storage()
+    st.store(KEY, val(1, b"aa"), 0.0, 100.0)
+    st.store(KEY, val(2, b"bbb"), 0.0, 100.0)
+    d = st.remove(KEY, 1)
+    assert d.size_diff == -2 and d.values_diff == -1
+    d2 = st.clear()
+    assert d2.size_diff == -3 and d2.values_diff == -1
+    assert st.empty()
+
+
+def test_storage_bucket_quota_tracking():
+    b = StorageBucket()
+    st = Storage()
+    v1, v2 = val(1, b"aaaa"), val(2, b"bb")
+    st.store(KEY, v1, 0.0, 50.0, bucket=b)
+    st.store(KEY, v2, 0.0, 100.0, bucket=b)
+    assert b.size == 6
+    assert b.get_oldest() == (KEY, 1)          # earliest expiration
+    st.expire(KEY, now=60.0)                   # v1 expires → erased from bucket
+    assert b.size == 2 and b.get_oldest() == (KEY, 2)
+    st.remove(KEY, 2)
+    assert b.size == 0 and b.get_oldest() is None
+
+
+# ---------------------------------------------------------------- ValueCache
+def _collector():
+    events = []
+    return events, lambda vals, expired: events.append(
+        (sorted(v.id for v in vals), expired))
+
+
+def test_value_cache_add_refresh_expire():
+    types = TypeStore()
+    types.register_type(ValueType(1, "t", expiration=100.0))
+    events, cb = _collector()
+    vc = ValueCache(cb)
+
+    nxt = vc.on_values([val(1, type_id=1), val(2, type_id=1)], (), (), types, now=0.0)
+    assert events == [([1, 2], False)]
+    assert nxt == 100.0
+
+    # peer refreshes id 1 → no event, expiration extended
+    events.clear()
+    vc.on_values((), [1], (), types, now=50.0)
+    assert events == []
+
+    # sweep at t=120: id 2 (exp 100) dies, id 1 (exp 150) survives
+    nxt = vc.expire_values(now=120.0)
+    assert events == [([2], True)]
+    assert nxt == 150.0 and len(vc) == 1
+
+    # peer-side explicit expire
+    events.clear()
+    vc.on_values((), (), [1], types, now=130.0)
+    assert events == [([1], True)]
+    assert len(vc) == 0
+
+
+def test_value_cache_clear_signals_expired():
+    events, cb = _collector()
+    vc = ValueCache(cb)
+    vc.on_values([val(5)], (), (), TypeStore(), now=0.0)
+    events.clear()
+    vc.clear()
+    assert events == [([5], True)]
+
+
+# ------------------------------------------------------------------ OpCaches
+def test_op_value_cache_refcounting():
+    events, cb = _collector()
+    c = OpValueCache(cb)
+    v = val(1)
+    c.on_value([v], False)          # ref 1 → new
+    c.on_value([v], False)          # ref 2 → no event
+    assert events == [([1], False)]
+    events.clear()
+    c.on_value([v], True)           # ref 1 → no event
+    assert events == []
+    c.on_value([v], True)           # ref 0 → expired
+    assert events == [([1], True)]
+
+
+def test_op_cache_replay_and_linger():
+    op = OpCache(now=0.0)
+    got = []
+    op.on_value([val(1), val(2)], False)
+    op.add_listener(1, lambda vals, exp: got.append([v.id for v in vals]) or True,
+                    None, None)
+    assert got == [[1, 2]]          # replay on attach
+    assert op.get_expiration() == TIME_MAX
+    op.remove_listener(1, now=10.0)
+    assert op.is_done()
+    assert op.get_expiration() == 10.0 + OP_LINGER
+    assert not op.is_expired(now=10.0 + OP_LINGER - 1)
+    assert op.is_expired(now=10.0 + OP_LINGER + 1)
+
+
+def test_op_cache_false_return_unsubscribes():
+    op = OpCache(now=0.0)
+    got = []
+
+    def once(vals, exp):
+        got.append([v.id for v in vals])
+        return False                     # one-shot listener
+
+    # empty cache → no replay fires, listener stays armed
+    op.add_listener(1, once, None, None)
+    assert not op.is_done() and got == []
+    # first real batch satisfies and unsubscribes it
+    op.on_value([val(1)], False)
+    assert got == [[1]] and op.is_done()
+
+    # a one-shot attaching to a warm cache is satisfied from replay
+    op.add_listener(2, once, None, None)
+    assert got == [[1], [1]] and op.is_done()
+
+    # a None-returning (plain Python) callback stays subscribed
+    op.add_listener(3, lambda vals, exp: got.append("keep"), None, None)
+    op.on_value([val(2)], False)
+    assert not op.is_done() and got[-2:] == ["keep", "keep"]
+
+
+def test_search_cache_dedups_network_ops():
+    sc = SearchCache()
+    started = []
+
+    def on_listen(q, cb):
+        started.append(q)
+        return 100 + len(started)
+
+    q = Query()
+    t1 = sc.listen(lambda v, e: True, q, None, on_listen, now=0.0)
+    t2 = sc.listen(lambda v, e: True, Query(), None, on_listen, now=0.0)
+    assert len(started) == 1        # second listen satisfied by the first op
+    assert t1 != t2
+
+    # a *narrower* query is satisfied by the broad one → still one op
+    sc.listen(lambda v, e: True, Query("WHERE id=5"), None, on_listen, now=0.0)
+    assert len(started) == 1
+
+    # cancel both listeners on op 1; after linger the op expires
+    cancelled = []
+    sc.cancel_listen(t1, now=1.0)
+    sc.cancel_listen(t2, now=1.0)
+    nxt = sc.expire(now=1.0, on_cancel=cancelled.append)
+    assert cancelled == []          # still lingering (third listener active)
+    assert nxt <= 1.0 + OP_LINGER or nxt == TIME_MAX
+
+
+def test_search_cache_expires_idle_ops():
+    sc = SearchCache()
+    tok = sc.listen(lambda v, e: True, Query(), None, lambda q, cb: 42, now=0.0)
+    sc.cancel_listen(tok, now=0.0)
+    cancelled = []
+    sc.expire(now=OP_LINGER + 1.0, on_cancel=cancelled.append)
+    assert cancelled == [42]
+    assert len(sc) == 0
+
+
+def test_search_cache_get_merges_ops():
+    sc = SearchCache()
+    caps = {}
+
+    def on_listen(q, cb):
+        caps[len(caps)] = cb
+        return len(caps)
+
+    sc.listen(lambda v, e: True, Query("SELECT id"), None, on_listen, now=0.0)
+    sc.listen(lambda v, e: True, Query("WHERE id=1"), None, on_listen, now=0.0)
+    assert len(caps) == 2           # neither query satisfies the other
+    caps[0]([val(1)], False)
+    caps[1]([val(2)], False)
+    assert sorted(v.id for v in sc.get()) == [1, 2]
+    assert sc.get_by_id(2).id == 2

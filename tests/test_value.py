@@ -1,0 +1,233 @@
+"""Value & query model tests — wire-layer round-trips, filters, the
+SQL-ish query parser, satisfiability, and default type policies
+(reference contracts: include/opendht/value.h, src/value.cpp,
+default_types.cpp)."""
+
+import msgpack
+import pytest
+
+from opendht_tpu.core.value import (
+    Field, FieldValue, FieldValueIndex, Filters, Query, RawPublicKey, Select,
+    TypeStore, Value, ValueType, Where, random_value_id, MAX_VALUE_SIZE,
+)
+from opendht_tpu.core.default_types import (
+    DEFAULT_TYPES, DhtMessage, IceCandidates, ImMessage, IpServiceAnnouncement,
+    TrustRequest, DHT_MESSAGE_TYPE, IP_SERVICE_ANNOUNCEMENT_TYPE,
+)
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.sockaddr import SockAddr
+
+
+# --------------------------------------------------------------- wire layers
+def test_plain_value_wire_roundtrip():
+    v = Value(b"hello", type_id=3, value_id=0xDEADBEEF, user_type="x/y")
+    v2 = Value.from_packed(v.get_packed())
+    assert v2 == v
+    assert v2.data == b"hello" and v2.type == 3 and v2.user_type == "x/y"
+    assert not v2.is_signed() and not v2.is_encrypted()
+
+
+def test_plain_value_wire_layout():
+    """The outer map must be exactly {id, dat:{body:{type,data}}} — key
+    set and nesting match the reference (value.h:470-511)."""
+    v = Value(b"d", type_id=1, value_id=7)
+    obj = msgpack.unpackb(v.get_packed(), raw=False)
+    assert set(obj) == {"id", "dat"}
+    assert obj["id"] == 7
+    assert set(obj["dat"]) == {"body"}
+    assert obj["dat"]["body"] == {"type": 1, "data": b"d"}
+
+
+def test_signed_value_wire_roundtrip():
+    v = Value(b"payload", type_id=3, value_id=42)
+    v.owner = RawPublicKey(b"\x30\x82fake-der")
+    v.seq = 5
+    v.signature = b"sig-bytes"
+    v.recipient = InfoHash.get("bob")
+    obj = msgpack.unpackb(v.get_packed(), raw=False)
+    assert set(obj["dat"]) == {"body", "sig"}
+    assert obj["dat"]["body"]["seq"] == 5
+    assert obj["dat"]["body"]["owner"] == b"\x30\x82fake-der"
+    assert obj["dat"]["body"]["to"] == bytes(InfoHash.get("bob"))
+
+    v2 = Value.from_packed(v.get_packed())
+    assert v2.is_signed() and v2.seq == 5
+    assert v2.owner.export_der() == b"\x30\x82fake-der"
+    assert v2.recipient == InfoHash.get("bob")
+    assert v2 == v
+
+
+def test_encrypted_value_wire_roundtrip():
+    v = Value(value_id=9)
+    v.cypher = b"\x01\x02\x03ciphertext"
+    obj = msgpack.unpackb(v.get_packed(), raw=False)
+    assert obj["dat"] == v.cypher     # bin passthrough, no map
+    v2 = Value.from_packed(v.get_packed())
+    assert v2.is_encrypted() and v2.cypher == v.cypher and v2 == v
+
+
+def test_malformed_value_raises():
+    with pytest.raises(ValueError):
+        Value.from_wire_obj({"id": 1})          # no dat
+    with pytest.raises(ValueError):
+        Value.from_wire_obj({"id": 1, "dat": {"body": {"type": 0}}})  # no data
+    # signed body without sig
+    with pytest.raises(ValueError):
+        Value.from_wire_obj(
+            {"id": 1, "dat": {"body": {"type": 0, "data": b"", "owner": b"k",
+                                       "seq": 0}}})
+
+
+def test_random_value_id_nonzero():
+    assert all(random_value_id() != 0 for _ in range(64))
+
+
+# ------------------------------------------------------------------- filters
+def test_filter_chaining():
+    va = Value(b"a", type_id=1, value_id=1)
+    vb = Value(b"b", type_id=2, value_id=2)
+    f = Filters.chain(Filters.value_type(1), Filters.id(1))
+    assert f(va) and not f(vb)
+    f_or = Filters.chain_or(Filters.id(1), Filters.id(2))
+    assert f_or(va) and f_or(vb)
+    assert Filters.apply(None, [va, vb]) == [va, vb]
+    assert Filters.apply(Filters.value_type(2), [va, vb]) == [vb]
+    assert Filters.chain(None, None) is None
+
+
+# ------------------------------------------------------------ query language
+def test_select_parse_and_wire():
+    s = Select("SELECT id, seq")
+    assert s.get_selection() == [Field.ID, Field.SEQ_NUM]
+    s2 = Select.from_wire_obj(s.wire_obj())
+    assert s2 == s
+    assert Select("select user_type").get_selection() == [Field.USER_TYPE]
+    assert Select("").empty()
+
+
+def test_where_parse_filter_and_wire():
+    w = Where("WHERE id=7, user_type=chat")
+    vals = [Value(b"x", value_id=7, user_type="chat"),
+            Value(b"y", value_id=7, user_type="mail"),
+            Value(b"z", value_id=8, user_type="chat")]
+    f = w.get_filter()
+    assert [f(v) for v in vals] == [True, False, False]
+    w2 = Where.from_wire_obj(w.wire_obj())
+    assert w2 == w
+    # quoted strings and owner hashes
+    h = InfoHash.get("owner")
+    w3 = Where(f'WHERE owner_pk={h}, user_type="a b"')
+    assert FieldValue(Field.OWNER_PK, h) in w3.filters
+
+
+def test_where_parse_error():
+    with pytest.raises(ValueError):
+        Where("WHERE nonsense=1")
+    with pytest.raises(ValueError):
+        Where("WHERE id=abc")          # non-numeric for a numeric field
+    assert Where('WHERE seq="5"').filters[0].value == 5
+
+
+def test_pack_fields_projection():
+    v = Value(b"d", type_id=2, value_id=9, user_type="u")
+    v.seq = 3
+    row = v.pack_fields([Field.ID, Field.VALUE_TYPE, Field.OWNER_PK,
+                         Field.SEQ_NUM, Field.USER_TYPE])
+    assert row == [9, 2, bytes(20), 3, "u"]
+
+
+def test_query_string_form_and_satisfiability():
+    q = Query("SELECT id WHERE user_type=chat")
+    assert q.select.get_selection() == [Field.ID]
+    assert len(q.where.filters) == 1
+
+    # satisfiability (src/value.cpp:505-519): a query asking for a subset
+    # of restrictions/fields is satisfied by the broader cached query
+    broad = Query(Select(), Where())              # everything, all fields
+    narrow = Query("SELECT id WHERE id=4")
+    assert narrow.where.is_satisfied_by(broad.where)
+    assert Query(none=True).is_satisfied_by(narrow)
+    # broad needs all fields; narrow's projection can't satisfy it
+    assert not broad.select.is_satisfied_by(narrow.select)
+    # same query satisfies itself
+    assert narrow.is_satisfied_by(Query("SELECT id WHERE id=4"))
+
+
+def test_query_wire_roundtrip():
+    q = Query("SELECT id,seq WHERE value_type=3")
+    q2 = Query.from_wire_obj(msgpack.unpackb(
+        msgpack.packb(q.wire_obj(), use_bin_type=True), raw=False))
+    assert q2 == q
+
+
+def test_field_value_index_projection():
+    v = Value(b"data", type_id=3, value_id=11, user_type="t")
+    v.owner = RawPublicKey(b"derkey")
+    v.seq = 2
+    fvi = FieldValueIndex(v, Select("SELECT id, seq"))
+    assert set(fvi.index) == {Field.ID, Field.SEQ_NUM}
+    assert fvi.index[Field.ID].value == 11
+    packed = fvi.pack_fields()
+    back = FieldValueIndex.unpack_fields([Field.ID, Field.SEQ_NUM], packed)
+    assert back.index[Field.SEQ_NUM].value == 2
+    assert back.contained_in(fvi)
+
+    full = FieldValueIndex(v, Select())
+    assert len(full.index) == 5
+    assert full.index[Field.OWNER_PK].value == v.owner.get_id()
+
+
+# --------------------------------------------------------------------- types
+def test_type_store_fallback():
+    ts = TypeStore()
+    for t in DEFAULT_TYPES:
+        ts.register_type(t)
+    assert ts.get_type(3).name == "IM message"
+    assert ts.get_type(999) is ValueType.USER_DATA
+
+
+def test_default_store_policy_size_cap():
+    big = Value(b"x" * (MAX_VALUE_SIZE + 1))
+    ok = Value(b"x")
+    assert not ValueType.default_store_policy(InfoHash(), big, InfoHash(), None)
+    assert ValueType.default_store_policy(InfoHash(), ok, InfoHash(), None)
+
+
+def test_dht_message_policy_and_filter():
+    key, frm = InfoHash.get("k"), InfoHash.get("f")
+    good = DhtMessage("svc", b"m").to_value()
+    empty = DhtMessage("", b"m").to_value()
+    assert DhtMessage.store_policy(key, good, frm, None)
+    assert not DhtMessage.store_policy(key, empty, frm, None)
+    f = DhtMessage.service_filter("svc")
+    assert f(good)
+    assert not f(DhtMessage("other", b"m").to_value())
+
+
+def test_ip_service_announcement_rewrites_to_sender():
+    """Anti-spoof: the stored address must be the sender's IP with the
+    announced port (default_types.cpp:68-82)."""
+    ann = IpServiceAnnouncement(SockAddr("1.2.3.4", 5000)).to_value()
+    sender = SockAddr("9.9.9.9", 1234)
+    assert IpServiceAnnouncement.store_policy(InfoHash(), ann, InfoHash(), sender)
+    stored = IpServiceAnnouncement.unpack(ann.data)
+    assert stored.addr == SockAddr("9.9.9.9", 5000)
+    # port 0 rejected
+    zero = IpServiceAnnouncement(SockAddr("1.2.3.4", 0)).to_value()
+    assert not IpServiceAnnouncement.store_policy(InfoHash(), zero, InfoHash(), sender)
+
+
+def test_payload_roundtrips():
+    im = ImMessage(1, "hi", 123, "text/plain")
+    assert ImMessage.unpack(im.pack()).msg == "hi"
+    tr = TrustRequest("svc", b"p", True)
+    back = TrustRequest.unpack(tr.pack())
+    assert back.service == "svc" and back.confirm
+    ic = IceCandidates(7, b"ice")
+    assert IceCandidates.unpack(ic.pack()).ice_data == b"ice"
+
+    v = im.to_value()
+    v.owner = RawPublicKey(b"k")
+    v.recipient = InfoHash.get("to")
+    m = ImMessage.from_value(v)
+    assert m.from_id == RawPublicKey(b"k").get_id() and m.to == InfoHash.get("to")
