@@ -55,7 +55,11 @@ class OpValueCache:
                 new_values.append(v)
             else:
                 slot.ref_count += 1
-        return True if not new_values else bool(self._callback(new_values, False))
+        if not new_values:
+            return True
+        # only an explicit False unsubscribes (None stays subscribed,
+        # matching LocalListener.notify)
+        return self._callback(new_values, False) is not False
 
     def on_values_expired(self, vals: List[Value]) -> bool:
         gone = []
@@ -66,7 +70,9 @@ class OpValueCache:
                 if slot.ref_count == 0:
                     gone.append(slot.data)
                     del self._values[v.id]
-        return True if not gone else bool(self._callback(gone, True))
+        if not gone:
+            return True
+        return self._callback(gone, True) is not False
 
     def get(self, f: Optional[Filter] = None) -> List[Value]:
         return Filters.apply(f, (s.data for s in self._values.values()))
@@ -83,16 +89,20 @@ class OpCache:
     """One shared network listen + its local listeners
     (op_cache.h:70-127)."""
 
-    def __init__(self, now: float = 0.0):
+    def __init__(self, now: float = 0.0, clock=None):
         self.cache = OpValueCache(self._dispatch)
         self._listeners: Dict[int, LocalListener] = {}
         self._last_removed = now
+        self._clock = clock
         self.search_token = 0       # token of the underlying network op
 
     def on_value(self, vals: List[Value], expired: bool) -> bool:
-        """Feed from the network op; False once no listeners remain."""
+        """Feed from the network op.  Always True: the shared op must
+        survive the 60 s listener-less linger so a quick re-listen reuses
+        a live subscription — teardown happens only through
+        SearchCache.expire/cancel_all cancelling ``search_token``."""
         self.cache.on_value(vals, expired)
-        return bool(self._listeners)
+        return True
 
     def _dispatch(self, vals: List[Value], expired: bool) -> bool:
         # A callback returning False unsubscribes (the ValueCallback
@@ -101,6 +111,8 @@ class OpCache:
         for token, l in list(self._listeners.items()):
             if not l.notify(vals, expired):
                 self._listeners.pop(token, None)
+                if self._clock is not None:
+                    self._last_removed = self._clock()
         return True
 
     def add_listener(self, token: int, cb: ValueCallback, query: Optional[Query],
@@ -137,10 +149,14 @@ class OpCache:
 
 
 class SearchCache:
-    """Query-keyed registry of shared listen ops (op_cache.h:129-153)."""
+    """Query-keyed registry of shared listen ops (op_cache.h:129-153).
+    ``clock`` (e.g. ``scheduler.time``) timestamps listener removals that
+    happen inside value dispatch, so the linger window is measured from
+    the true last removal."""
 
-    def __init__(self):
+    def __init__(self, clock=None):
         self._ops: Dict[Query, OpCache] = {}
+        self._clock = clock
         self._next_token = 1
         self._next_expiration = TIME_MAX
 
@@ -158,7 +174,7 @@ class SearchCache:
                     op = cand
                     break
         if op is None:
-            op = OpCache(now)
+            op = OpCache(now, clock=self._clock)
             self._ops[query] = op
             op.search_token = on_listen(query, op.on_value)
         token = self._next_token

@@ -44,11 +44,16 @@ class StorageBucket:
                       key=lambda e: e[0])
 
     def erase(self, key: InfoHash, value: Value, expiration: float) -> None:
-        for i, (exp, k, vid, sz) in enumerate(self._entries):
-            if exp == expiration and k == key and vid == value.id:
-                del self._entries[i]
+        # entries are expiration-sorted: scan only the equal-expiration run
+        entries = self._entries
+        i = bisect.bisect_left(entries, expiration, key=lambda e: e[0])
+        while i < len(entries) and entries[i][0] == expiration:
+            _, k, vid, sz = entries[i]
+            if k == key and vid == value.id:
+                del entries[i]
                 self._total -= sz
                 return
+            i += 1
 
     @property
     def size(self) -> int:

@@ -676,13 +676,13 @@ class FieldValueIndex:
                 self.index[f] = FieldValue(f, value.user_type)
 
     def contained_in(self, other: "FieldValueIndex") -> bool:
-        """(src/value.cpp:330-341): same fields present with equal values —
-        the reference compares only field presence at the index level, but
-        callers pair it with equal projections; we compare values too for
-        stricter dedup."""
+        """Same fields present with equal values.  Stricter than the
+        reference (src/value.cpp:330-341), which checks field presence
+        only — value equality is what reply dedup actually needs."""
         if len(self.index) > len(other.index):
             return False
-        return all(f in other.index for f in self.index)
+        return all(f in other.index and self.index[f] == other.index[f]
+                   for f in self.index)
 
     def pack_fields(self) -> list:
         """Wire array of field values, canonical field order."""

@@ -191,6 +191,39 @@ def test_op_cache_false_return_unsubscribes():
     assert not op.is_done() and got[-2:] == ["keep", "keep"]
 
 
+def test_op_cache_feed_survives_linger():
+    """A push arriving during the listener-less linger must not tear down
+    the network op; a re-listen then sees the fresh value."""
+    clk = [0.0]
+    sc = SearchCache(clock=lambda: clk[0])
+    feeds = []
+    tok = sc.listen(lambda v, e: True, Query(), None,
+                    lambda q, cb: feeds.append(cb) or 1, now=0.0)
+    sc.cancel_listen(tok, now=0.0)
+    clk[0] = 5.0
+    assert feeds[0]([val(7)], False) is True     # op stays subscribed
+    got = []
+    sc.listen(lambda v, e: got.append([x.id for x in v]), Query(), None,
+              lambda q, cb: feeds.append(cb) or 2, now=5.0)
+    assert len(feeds) == 1                       # reused, not re-subscribed
+    assert got == [[7]]                          # fresh value replayed
+
+
+def test_op_value_cache_none_return_keeps_subscription():
+    c = OpValueCache(lambda vals, exp: None)     # plain Python callback
+    assert c.on_value([val(1)], False) is True
+    assert c.on_value([val(1)], True) is True
+
+
+def test_field_value_index_contained_in_compares_values():
+    from opendht_tpu.core.value import FieldValueIndex, Select
+    a = FieldValueIndex(val(1), Select("SELECT id"))
+    b = FieldValueIndex(val(2), Select("SELECT id"))
+    a2 = FieldValueIndex(val(1), Select("SELECT id"))
+    assert not a.contained_in(b)
+    assert a.contained_in(a2)
+
+
 def test_search_cache_dedups_network_ops():
     sc = SearchCache()
     started = []
