@@ -345,12 +345,13 @@ class NodeTable:
         return self._bucket_count.copy()
 
     def stale_buckets(self, now: float, age: float = NODE_EXPIRE_TIME) -> np.ndarray:
-        """Bucket indices not heard from within `age` seconds
-        (↔ bucketMaintenance's 10-min staleness rule, src/dht.cpp:1780-1838)."""
-        last = np.asarray(radix.bucket_last_seen(
-            jnp.asarray(self.self_limbs), jnp.asarray(self._ids),
-            jnp.asarray(self._valid), jnp.asarray(self._time_seen),
-        ))
+        """Occupied buckets with no *reply* within `age` seconds — incl.
+        never-replied buckets, which the reference marks stale from birth
+        (Bucket::time = time_point::min(); bucketMaintenance's 10-min
+        rule, src/dht.cpp:1780-1838, src/routing_table.cpp:210-211)."""
+        last = np.full(radix.ID_BITS, -np.inf)
+        rows = self._valid & (self._time_reply > 0)
+        np.maximum.at(last, self._bucket[rows], self._time_reply[rows])
         occupied = self._bucket_count > 0
         return np.nonzero(occupied & (last < now - age))[0]
 

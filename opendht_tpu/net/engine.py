@@ -189,6 +189,11 @@ class NetworkEngine:
         out: dict = {body_key: body}
         if query is not None:
             out["q"] = query
+        if self.is_client:
+            # advertise client mode so peers keep us out of routing tables
+            # (parsed on rx as 's', parsed_message.h:143-144; the reference
+            # reads but never sends it — emitting is forward-compatible)
+            out["s"] = True
         out["t"] = pack_tid(tid)
         out["y"] = y
         out["v"] = AGENT
@@ -364,7 +369,8 @@ class NetworkEngine:
                 raise DhtProtocolException(DhtProtocolException.UNKNOWN_TID,
                                            "Can't find socket", msg.id)
             node.received(now)
-            self.cb.on_new_node(node, 2)
+            if not node.is_client:
+                self.cb.on_new_node(node, 2)
             self.deserialize_nodes(msg, from_addr)
             rsocket.on_receive(node, msg)
             return

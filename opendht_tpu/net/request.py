@@ -72,9 +72,12 @@ class Request:
         return not self.pending
 
     def is_expired(self, now: float) -> bool:
-        """All attempts used and the last one timed out (request.h:110-112)."""
+        """All attempts used and the last one timed out (request.h:110-112).
+        ``>=``, not ``>``: retries are scheduled at exactly
+        last_try + MAX_RESPONSE_TIME, and discrete-event drivers land on
+        that instant — strict compare would retry dead nodes forever."""
         return (self.pending
-                and now > self.last_try + MAX_RESPONSE_TIME
+                and now >= self.last_try + MAX_RESPONSE_TIME
                 and self.attempt_count >= MAX_ATTEMPT_COUNT)
 
     # -- transitions (request.h:88-105) ------------------------------------

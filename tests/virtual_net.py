@@ -1,0 +1,117 @@
+"""In-process virtual UDP network for driving whole Dht nodes.
+
+The tier-3 analogue of the reference's netns cluster harness
+(python/tools/dht/network.py, virtual_network_builder.py) with no real
+sockets: every node's injected ``send_fn`` enqueues datagrams on a
+shared event queue, a virtual clock advances to the next packet arrival
+or scheduler wakeup, and delivery calls the destination's
+``periodic(data, from_addr)``.  Deterministic, immune to wall-clock
+flakiness, and able to jump hours of protocol time (token rotation,
+value expiry) in milliseconds.  Optional per-packet loss and delay play
+the role of netem (benchmark.py -l/-d).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+from typing import Callable, Dict, List, Optional
+
+from opendht_tpu.runtime import Config, Dht
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+from opendht_tpu.utils import TIME_MAX
+
+
+class VirtualNet:
+    def __init__(self, *, delay: float = 0.01, jitter: float = 0.0,
+                 loss: float = 0.0, seed: int = 42):
+        self.clock = 0.0
+        self.delay = delay
+        self.jitter = jitter
+        self.loss = loss
+        self.rng = random.Random(seed)
+        self.nodes: Dict[tuple, Dht] = {}
+        self._queue: list = []          # (arrival, seq, data, src, dst_key)
+        self._seq = itertools.count()
+        self._next_port = 20000
+        self.dropped = 0
+
+    # ------------------------------------------------------------- topology
+    def add_node(self, config: Optional[Config] = None,
+                 host: str = "127.0.0.1", port: Optional[int] = None) -> Dht:
+        if port is None:
+            port = self._next_port
+            self._next_port += 1
+        addr = SockAddr(host, port)
+        key = (addr.host, addr.port)
+
+        def send_fn(data: bytes, dest: SockAddr, _src=addr) -> int:
+            if self.loss and self.rng.random() < self.loss:
+                self.dropped += 1
+                return 0
+            arrival = self.clock + self.delay + \
+                (self.rng.random() * self.jitter if self.jitter else 0.0)
+            heapq.heappush(self._queue, (arrival, next(self._seq), data,
+                                         _src, (dest.host, dest.port)))
+            return 0
+
+        dht = Dht(send_fn, config, Scheduler(clock=lambda: self.clock),
+                  has_v6=False)
+        dht.bound_addr = addr
+        self.nodes[key] = dht
+        return dht
+
+    def bootstrap_all(self, seed_node: Dht) -> None:
+        """Point every other node at the seed and ping it (↔ the runner's
+        bootstrap thread, reference src/dhtrunner.cpp:819-875)."""
+        for dht in self.nodes.values():
+            if dht is not seed_node:
+                dht.insert_node(seed_node.myid, seed_node.bound_addr)
+                dht.ping_node(seed_node.bound_addr)
+
+    # ------------------------------------------------------------ event loop
+    def _next_event_time(self) -> float:
+        t = self._queue[0][0] if self._queue else TIME_MAX
+        for dht in self.nodes.values():
+            t = min(t, dht.scheduler.next_job_time())
+        return t
+
+    def run(self, max_time: float = 30.0,
+            until: Optional[Callable[[], bool]] = None,
+            max_events: int = 1_000_000) -> bool:
+        """Advance virtual time; returns True as soon as `until()` holds."""
+        deadline = self.clock + max_time
+        for _ in range(max_events):
+            if until is not None and until():
+                return True
+            t = self._next_event_time()
+            if t > deadline:
+                self.clock = deadline
+                break
+            self.clock = max(self.clock, t)
+            # deliver all packets due now
+            while self._queue and self._queue[0][0] <= self.clock:
+                _, _, data, src, dst_key = heapq.heappop(self._queue)
+                dst = self.nodes.get(dst_key)
+                if dst is not None:
+                    dst.periodic(data, src)
+            # run due scheduler jobs everywhere
+            for dht in self.nodes.values():
+                if dht.scheduler.next_job_time() <= self.clock:
+                    dht.periodic(None, None)
+        return until() if until is not None else False
+
+    def settle(self, seconds: float) -> None:
+        """Run with no exit condition for `seconds` of virtual time."""
+        self.run(max_time=seconds, until=None)
+
+    # ------------------------------------------------------------- helpers
+    def connected_count(self) -> int:
+        from opendht_tpu.runtime import NodeStatus
+        return sum(1 for d in self.nodes.values()
+                   if d.get_status() is NodeStatus.CONNECTED)
+
+    def all_connected(self) -> bool:
+        return self.connected_count() == len(self.nodes)
