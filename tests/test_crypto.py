@@ -1,0 +1,195 @@
+"""Crypto layer tests, mirroring the reference suite tests/cryptotester.cpp
+(testSignatureEncryption :33-88, testCertificateRevocation) plus coverage of
+the serialization/KDF helpers."""
+
+import datetime
+
+import pytest
+
+from opendht_tpu import crypto
+from opendht_tpu.infohash import InfoHash
+
+
+@pytest.fixture(scope="module")
+def identity():
+    # small RSA keys keep the suite fast; 1024 still exercises every path
+    return crypto.generate_identity("testsign", key_length=1024)
+
+
+@pytest.fixture(scope="module")
+def ec_identity():
+    return crypto.generate_ec_identity("testsign-ec")
+
+
+def test_sign_verify(identity):
+    key = identity.first
+    pk = key.public_key()
+    data = b"hello dht" * 10
+    sig = key.sign(data)
+    assert pk.check_signature(data, sig)
+    assert not pk.check_signature(data + b"!", sig)
+    assert not pk.check_signature(data, sig[:-1] + bytes([sig[-1] ^ 1]))
+
+
+def test_sign_verify_ec(ec_identity):
+    key = ec_identity.first
+    pk = key.public_key()
+    data = b"elliptic"
+    sig = key.sign(data)
+    assert pk.check_signature(data, sig)
+    assert not pk.check_signature(b"other", sig)
+
+
+@pytest.mark.parametrize("size", [0, 1, 100, 500, 2000, 65536])
+def test_encrypt_decrypt_roundtrip(identity, size):
+    # cryptotester.cpp:45-58: both the plain-RSA and the hybrid path
+    key = identity.first
+    data = bytes(range(256)) * (size // 256) + bytes(range(size % 256))
+    cipher = key.public_key().encrypt(data)
+    assert key.decrypt(cipher) == data
+    if size > key.public_key()._pk.key_size // 8 - 11:
+        # hybrid layout: RSA block + IV + ct + tag
+        assert len(cipher) == (key.public_key()._pk.key_size // 8
+                               + crypto.GCM_IV_SIZE + size
+                               + crypto.GCM_DIGEST_SIZE)
+
+
+def test_decrypt_garbage_fails(identity):
+    with pytest.raises(crypto.CryptoException):
+        identity.first.decrypt(b"short")
+    cipher = identity.first.public_key().encrypt(b"x" * 4000)
+    bad = bytes([cipher[0] ^ 1]) + cipher[1:]
+    with pytest.raises(crypto.CryptoException):
+        identity.first.decrypt(bad)
+
+
+def test_aes_roundtrip():
+    key = bytes(range(32))
+    data = b"secret payload"
+    enc = crypto.aes_encrypt(data, key)
+    assert crypto.aes_decrypt(enc, key) == data
+    with pytest.raises(crypto.DecryptError):
+        crypto.aes_decrypt(enc[:-1] + bytes([enc[-1] ^ 1]), key)
+    with pytest.raises(crypto.DecryptError):
+        crypto.aes_encrypt(data, b"badlen")
+
+
+def test_aes_password_roundtrip():
+    enc = crypto.aes_encrypt_password(b"data", "hunter2")
+    assert crypto.aes_decrypt_password(enc, "hunter2") == b"data"
+    with pytest.raises(crypto.DecryptError):
+        crypto.aes_decrypt_password(enc, "wrong")
+
+
+def test_stretch_key_deterministic():
+    k1, salt = crypto.stretch_key("pw", None, 32)
+    k2, _ = crypto.stretch_key("pw", salt, 32)
+    assert k1 == k2 and len(k1) == 32
+    k3, _ = crypto.stretch_key("pw2", salt, 32)
+    assert k3 != k1
+
+
+def test_hash_by_length():
+    import hashlib
+    d = b"data"
+    assert crypto.hash_data(d, 20) == hashlib.sha1(d).digest()
+    assert crypto.hash_data(d, 32) == hashlib.sha256(d).digest()
+    assert crypto.hash_data(d, 64) == hashlib.sha512(d).digest()
+
+
+def test_key_serialize_roundtrip(identity):
+    pem = identity.first.serialize()
+    key2 = crypto.PrivateKey(pem)
+    assert key2.public_key().get_id() == identity.first.public_key().get_id()
+    enc = identity.first.serialize("pw")
+    key3 = crypto.PrivateKey(enc, password="pw")
+    assert key3.public_key().get_id() == identity.first.public_key().get_id()
+    with pytest.raises(crypto.CryptoException):
+        crypto.PrivateKey(enc, password="nope")
+
+
+def test_public_key_der_roundtrip(identity):
+    pk = identity.first.public_key()
+    pk2 = crypto.PublicKey(pk.export_der())
+    assert pk2.get_id() == pk.get_id()
+    assert pk2 == pk
+    data, sig = b"msg", identity.first.sign(b"msg")
+    assert pk2.check_signature(data, sig)
+
+
+def test_certificate_identity(identity):
+    cert = identity.second
+    assert cert.get_name() == "testsign"
+    assert cert.get_uid() == str(identity.first.public_key().get_id())
+    assert cert.get_id() == identity.first.public_key().get_id()
+    assert cert.is_ca()  # no CA given → self-signed CA
+
+
+def test_certificate_pack_roundtrip(identity):
+    packed = identity.second.pack()
+    cert2 = crypto.Certificate(packed)
+    assert cert2.get_id() == identity.second.get_id()
+    assert cert2.get_name() == "testsign"
+
+
+def test_certificate_chain():
+    ca = crypto.generate_identity("acme CA", key_length=1024)
+    dev = crypto.generate_identity("acme device", ca, key_length=1024)
+    assert not dev.second.is_ca()
+    assert dev.second.get_issuer_name() == "acme CA"
+    assert dev.second.issuer is not None
+    assert dev.second.signed_by(ca.second)
+    # chain survives pack/unpack (leaf-first concatenated DER)
+    again = crypto.Certificate(dev.second.pack())
+    assert again.issuer is not None
+    assert again.issuer.get_id() == ca.second.get_id()
+    assert again.signed_by(ca.second)
+
+
+def test_trust_list_and_revocation():
+    # cryptotester.cpp:33-60: device cert trusted via CA, then revoked
+    ca = crypto.generate_identity("acme CA", key_length=1024)
+    dev = crypto.generate_identity("acme device", ca, key_length=1024)
+    other = crypto.generate_identity("other dev", key_length=1024)
+
+    tl = crypto.TrustList()
+    tl.add(ca.second)
+    assert tl.verify(dev.second)
+    assert not tl.verify(other.second)
+
+    crl = crypto.RevocationList()
+    crl.revoke(dev.second)
+    crl.sign(ca)
+    assert crl.is_signed_by(ca.second)
+    assert crl.is_revoked(dev.second)
+
+    tl.add_revocation_list(crl)
+    res = tl.verify(dev.second)
+    assert not res and "revoked" in res.reason
+
+
+def test_crl_pack_roundtrip():
+    ca = crypto.generate_identity("ca", key_length=1024)
+    dev = crypto.generate_identity("dev", ca, key_length=1024)
+    crl = crypto.RevocationList()
+    crl.revoke(dev.second)
+    crl.sign(ca)
+    crl2 = crypto.RevocationList(crl.pack())
+    assert crl2.is_revoked(dev.second)
+    assert crl2.get_issuer_name() == "ca"
+    assert crl2.is_signed_by(ca.second)
+
+
+def test_value_owner_integration(identity):
+    """The real PublicKey satisfies core.value's owner protocol."""
+    from opendht_tpu.core.value import Value, RawPublicKey
+    v = Value(b"payload")
+    v.owner = identity.first.public_key()
+    v.seq = 1
+    v.signature = identity.first.sign(v.get_to_sign())
+    assert v.check_signature()
+    # wire round-trip: owner comes back as DER; re-parse and verify
+    v2 = Value.from_packed(v.get_packed())
+    assert isinstance(v2.owner, RawPublicKey)
+    v2.owner = crypto.PublicKey(v2.owner.export_der())
+    assert v2.check_signature()
