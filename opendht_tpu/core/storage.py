@@ -164,8 +164,14 @@ class Storage:
                 return StoreDiff(-sz, -1, 0)
         return StoreDiff()
 
-    def clear(self) -> StoreDiff:
-        """(storage.h:240-247)"""
+    def clear(self, key: "InfoHash | None" = None) -> StoreDiff:
+        """(storage.h:240-247).  Pass the storage key so quota-tracked
+        values are also unlinked from their per-IP StorageBucket; without
+        it the buckets would keep phantom entries and break eviction."""
+        if key is not None:
+            for vs in self.values:
+                if vs.store_bucket:
+                    vs.store_bucket.erase(key, vs.data, vs.expiration)
         d = StoreDiff(-self.total_size, -len(self.values), 0)
         self.values.clear()
         self.total_size = 0

@@ -369,8 +369,10 @@ class NetworkEngine:
                 raise DhtProtocolException(DhtProtocolException.UNKNOWN_TID,
                                            "Can't find socket", msg.id)
             node.received(now)
-            if not node.is_client:
-                self.cb.on_new_node(node, 2)
+            # reply-confirmed nodes are reported unconditionally; the
+            # client filter only applies to confirm=1 query paths
+            # (network_engine.cpp:496-528,570-572)
+            self.cb.on_new_node(node, 2)
             self.deserialize_nodes(msg, from_addr)
             rsocket.on_receive(node, msg)
             return
@@ -393,8 +395,7 @@ class NetworkEngine:
                         DhtProtocolException.UNKNOWN_TID,
                         "Can't find transaction", msg.id)
             node.received(now, req)
-            if not node.is_client:
-                self.cb.on_new_node(node, 2)
+            self.cb.on_new_node(node, 2)
             self.cb.on_reported_addr(msg.id, msg.addr)
 
             if req is not None and req.over:

@@ -9,7 +9,9 @@ vectorizes that partition and the maintenance sweeps built on it:
 
 - ``bucket_of``       peer → bucket index (= clipped commonBits with self)
 - ``bucket_counts``   per-bucket occupancy via one segment-sum
-- ``bucket_last_seen``per-bucket max last-reply time (staleness sweep,
+- ``bucket_last_seen``per-bucket max last-reply time (device-side variant
+  of the staleness sweep; NodeTable.stale_buckets uses a host-side numpy
+  reduction with never-replied semantics,
                       ↔ bucketMaintenance's 10-min rule, src/dht.cpp:1780-1838)
 - ``random_id_in_bucket`` uniform id inside a bucket's range
                       (↔ RoutingTable::randomId, src/routing_table.cpp:67-85)

@@ -326,6 +326,8 @@ class Dht:
                 if victim is None:
                     log.error("[search %s] maximum number of searches reached",
                               target)
+                    if done_cb:
+                        done_cb(False, [])
                     return None
                 old = srs.pop(victim)
                 old.stop()
@@ -828,15 +830,21 @@ class Dht:
                     done_cb(state["ok4"] or state["ok6"], nodes)
             return cb
 
-        for af, flag, ok_flag in ((_socket.AF_INET, "done4", "ok4"),
-                                  (_socket.AF_INET6, "done6", "ok6")):
+        # preset non-running families first so a synchronous callback from
+        # _announce (value already announced / search unavailable) sees the
+        # final flag state and can complete the put
+        families = ((_socket.AF_INET, "done4", "ok4"),
+                    (_socket.AF_INET6, "done6", "ok6"))
+        for af, flag, _ok in families:
+            if not self.is_running(af):
+                state[flag] = True
+        for af, flag, ok_flag in families:
             if self.is_running(af):
                 self._announce(key, af, value, mk_done(flag, ok_flag),
                                created, permanent)
-            else:
-                state[flag] = True
-        if not self.tables and done_cb:
-            done_cb(False, [])
+        if done_cb and not state["done"] and state["done4"] and state["done6"]:
+            state["done"] = True
+            done_cb(state["ok4"] or state["ok6"], [])
 
     def _announce(self, key: InfoHash, af: int, value: Value, callback,
                   created: Optional[float], permanent: bool) -> None:
@@ -1108,7 +1116,9 @@ class Dht:
         (↔ Dht::dataPersistence, src/dht.cpp:1840-1852)."""
         st = self.store.get(key)
         now = self.scheduler.time()
-        if st is None or now <= st.maintenance_time:
+        # run when due; `<` (not `<=`) so a discrete-event driver that lands
+        # exactly on maintenance_time still republishes and reschedules
+        if st is None or now < st.maintenance_time:
             return
         self._maintain_storage(key, st)
         st.maintenance_time = now + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
@@ -1135,7 +1145,7 @@ class Dht:
                         announced += 1
                 still_responsible[af] = False
         if self.tables and not any(still_responsible.values()):
-            diff = st.clear()
+            diff = st.clear(key)
             self.total_store_size += diff.size_diff
             self.total_values += diff.values_diff
         return announced

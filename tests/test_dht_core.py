@@ -194,3 +194,24 @@ def test_export_import_values():
     b.import_values(exported)
     vals = b.get_local(key)
     assert vals and vals[0].data == b"persisted"
+
+
+def test_repeated_put_fires_done_cb():
+    """Regression: a second put of an already-announced value completes via
+    a synchronous callback from _announce; the done_cb must still fire."""
+    net = make_net(5)
+    assert net.run(60, net.all_connected)
+    nodes = list(net.nodes.values())
+    key = InfoHash.get("again")
+    val = Value(b"same value twice")
+    val.id = 42
+
+    first = {}
+    nodes[2].put(key, val, lambda ok, ns: first.update(ok=ok))
+    assert net.run(60, lambda: "ok" in first), "first put never completed"
+    assert first["ok"]
+
+    second = {}
+    nodes[2].put(key, val, lambda ok, ns: second.update(ok=ok))
+    assert net.run(60, lambda: "ok" in second), "second put lost its done_cb"
+    assert second["ok"]
