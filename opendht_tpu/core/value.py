@@ -165,6 +165,25 @@ class Value:
         return (self.is_signed()
                 and self.owner.check_signature(self.get_to_sign(), self.signature))
 
+    def sign(self, key) -> None:
+        """Sign with a PrivateKey-like object: sets owner to its public key
+        and signature over the signed body (value.h:331-336)."""
+        if self.is_encrypted():
+            raise ValueError("Can't sign encrypted data")
+        self.owner = key.public_key()
+        self.signature = key.sign(self.get_to_sign())
+
+    def encrypt(self, from_key, to_pk) -> "Value":
+        """Sign with ``from_key``, then return a new Value carrying only the
+        cypher encrypted to ``to_pk`` (value.h:350-360)."""
+        if self.is_encrypted():
+            raise ValueError("Data is already encrypted")
+        self.recipient = to_pk.get_id()
+        self.sign(from_key)
+        nv = Value(value_id=self.id)
+        nv.cypher = to_pk.encrypt(self.get_to_encrypt())
+        return nv
+
     # -- wire layers (see module docstring) --------------------------------
     def to_sign_obj(self) -> dict:
         """Innermost layer: the signed body (value.h:470-487)."""
@@ -338,6 +357,17 @@ class Filters:
     @staticmethod
     def apply(f: Optional[Filter], values: Iterable["Value"]) -> List["Value"]:
         return list(values) if not f else [v for v in values if f(v)]
+
+    @staticmethod
+    def type_filter(type_id: int) -> Filter:
+        """Value::TypeFilter (value.h:187-191)."""
+        tid = int(type_id.id) if hasattr(type_id, "id") else int(type_id)
+        return lambda v: v.type == tid
+
+    @staticmethod
+    def id_filter(vid: int) -> Filter:
+        """Value::IdFilter (value.h:181-185)."""
+        return lambda v: v.id == vid
 
     # field filters
     @staticmethod

@@ -1,0 +1,190 @@
+"""SecureDht overlay tests: signed/encrypted puts over the virtual network,
+certificate discovery, and the secure store/edit policies
+(↔ reference src/securedht.cpp behavior; no direct reference test exists for
+this layer beyond python binding smoke tests, so coverage here is broader)."""
+
+import pytest
+
+from opendht_tpu import crypto
+from opendht_tpu.core.value import Filters, Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.runtime.config import Config
+from opendht_tpu.runtime.secure_dht import (
+    CERTIFICATE_TYPE, SecureDht, secure_node_id)
+
+from virtual_net import VirtualNet
+
+
+@pytest.fixture(scope="module")
+def identities():
+    # module-scoped: RSA keygen is the slow part
+    return [crypto.generate_identity(f"node{i}", key_length=1024)
+            for i in range(3)]
+
+
+def make_secure_net(identities, n_plain: int = 4):
+    """A virtual net with `n_plain` plain nodes + one SecureDht per
+    identity, all connected."""
+    net = VirtualNet()
+    seed = net.add_node()
+    for _ in range(n_plain - 1):
+        net.add_node()
+    secured = []
+    for ident in identities:
+        d = net.add_node(Config(node_id=secure_node_id(ident.second)))
+        secured.append(SecureDht(d, ident))
+    net.bootstrap_all(seed)
+    assert net.run(90, net.all_connected), "virtual net never connected"
+    return net, secured
+
+
+def test_put_signed_get_verified(identities):
+    net, (a, b, _) = make_secure_net(identities)
+    key = InfoHash.get("signed-key")
+    v = Value(b"signed payload")
+
+    done = {}
+    a.put_signed(key, v, lambda ok, ns: done.update(ok=ok))
+    assert net.run(90, lambda: "ok" in done), "put_signed never completed"
+    assert done["ok"]
+    assert v.seq >= 0 and v.is_signed()
+
+    got = []
+    b.get(key, lambda vals: got.extend(vals) or True)
+    assert net.run(60, lambda: got), "get never saw the signed value"
+    assert got[0].data == b"signed payload"
+    assert got[0].is_signed() and got[0].check_signature()
+    assert got[0].owner.get_id() == a.get_id()
+    # sender's key was cached during verification
+    assert b.get_public_key(a.get_id()) is not None
+
+
+def test_put_signed_bumps_seq(identities):
+    net, (a, b, _) = make_secure_net(identities)
+    key = InfoHash.get("seq-key")
+    v1 = Value(b"version one")
+    v1.id = 7
+    done1 = {}
+    a.put_signed(key, v1, lambda ok, ns: done1.update(ok=ok))
+    assert net.run(90, lambda: "ok" in done1) and done1["ok"]
+    seq1 = v1.seq
+
+    v2 = Value(b"version two")
+    v2.id = 7
+    done2 = {}
+    a.put_signed(key, v2, lambda ok, ns: done2.update(ok=ok))
+    assert net.run(90, lambda: "ok" in done2) and done2["ok"]
+    assert v2.seq > seq1
+
+    # the network converges on the newer version
+    got = []
+    b.get(key, lambda vals: got.extend(vals) or True,
+          f=Filters.id_filter(7))
+    assert net.run(60, lambda: got)
+    assert all(v.data == b"version two" for v in got)
+
+
+def test_put_encrypted_only_recipient_reads(identities):
+    net, (a, b, c) = make_secure_net(identities)
+    key = InfoHash.get("encrypted-key")
+    v = Value(b"for bob only")
+
+    done = {}
+    a.put_encrypted(key, b.get_id(), v, lambda ok, ns: done.update(ok=ok))
+    assert net.run(120, lambda: "ok" in done), "put_encrypted never completed"
+    assert done["ok"]
+
+    got_b, got_c = [], []
+    b.get(key, lambda vals: got_b.extend(vals) or True)
+    assert net.run(60, lambda: got_b), "recipient never decrypted the value"
+    assert got_b[0].data == b"for bob only"
+    assert got_b[0].owner.get_id() == a.get_id()
+
+    # third party can't open it: the encrypted value is dropped
+    state = {}
+    c.get(key, lambda vals: got_c.extend(vals) or True,
+          done_cb=lambda ok, ns: state.update(done=True))
+    assert net.run(60, lambda: "done" in state)
+    assert not got_c
+
+    # but the raw (unwrapped) dht sees the cypher blob — it was stored
+    raw = []
+    c._dht.get(key, lambda vals: raw.extend(vals) or True)
+    assert net.run(60, lambda: raw)
+    assert raw[0].is_encrypted()
+
+
+def test_find_certificate(identities):
+    net, (a, b, _) = make_secure_net(identities)
+    # a's constructor published its certificate; let it announce
+    net.settle(5.0)
+    found = []
+    b.find_certificate(a.get_id(), found.append)
+    assert net.run(90, lambda: found), "find_certificate never returned"
+    assert found[0] is not None
+    assert found[0].get_id() == a.get_id()
+    # second lookup hits the cache synchronously
+    again = []
+    b.find_certificate(a.get_id(), again.append)
+    assert again and again[0].get_id() == a.get_id()
+
+
+def test_certificate_type_policy(identities):
+    """CERTIFICATE_TYPE store policy: only at the matching key."""
+    ident = identities[0]
+    v = Value(ident.second.pack())
+    v.type = CERTIFICATE_TYPE.id
+    ok_key = ident.second.get_id()
+    bad_key = InfoHash.get("not the key")
+    assert CERTIFICATE_TYPE.store_policy(ok_key, v, None, None)
+    assert not CERTIFICATE_TYPE.store_policy(bad_key, v, None, None)
+
+
+def test_secure_store_policy_rejects_bad_signature(identities):
+    net, (a, b, _) = make_secure_net(identities)
+    key = InfoHash.get("tamper-key")
+    v = Value(b"authentic")
+    v.seq = 0
+    v.sign(identities[0].first)
+    v.data = b"tampered!!"           # invalidates the signature
+
+    # push the tampered value through the plain dht put path
+    done = {}
+    a._dht.put(key, v, lambda ok, ns: done.update(ok=ok))
+    net.run(90, lambda: "ok" in done)
+
+    got = []
+    b.get(key, lambda vals: got.extend(vals) or True)
+    state = {}
+    b.get(key, lambda vals: True, lambda ok, ns: state.update(done=True))
+    assert net.run(60, lambda: "done" in state)
+    assert not got, "tampered signed value should never be stored/surfaced"
+
+
+def test_edit_policy_requires_increasing_seq(identities):
+    net, secured = make_secure_net(identities, n_plain=2)
+    a = secured[0]
+    # exercise the secure edit policy directly on a plain node's type store
+    vt = a._dht.types.get_type(Value(b"").type)   # USER_DATA secured
+    key = InfoHash.get("edit")
+    old = Value(b"old")
+    old.seq = 5
+    old.sign(identities[0].first)
+    new_ok = Value(b"new")
+    new_ok.seq = 6
+    new_ok.sign(identities[0].first)
+    new_stale = Value(b"stale")
+    new_stale.seq = 4
+    new_stale.sign(identities[0].first)
+    other = Value(b"other owner")
+    other.seq = 7
+    other.sign(identities[1].first)
+
+    assert vt.edit_policy(key, old, new_ok, None, None)
+    assert not vt.edit_policy(key, old, new_stale, None, None)
+    assert not vt.edit_policy(key, old, other, None, None)
+    # same seq + identical body may be re-announced
+    same = Value(b"old")
+    same.seq = 5
+    same.sign(identities[0].first)
+    assert vt.edit_policy(key, old, same, None, None)
