@@ -24,3 +24,10 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 __version__ = "0.1.0"
 
 from .infohash import InfoHash, PkId, random_infohash  # noqa: F401
+from .core.value import Value, ValueType, Query, Select, Where, Filters  # noqa: F401
+from .runtime.config import Config, NodeStats, NodeStatus, SecureDhtConfig  # noqa: F401
+from .runtime.runner import DhtRunner, RunnerConfig  # noqa: F401
+from .crypto import (  # noqa: F401
+    Certificate, Identity, PrivateKey, PublicKey, RevocationList, TrustList,
+    generate_identity, generate_ec_identity,
+)

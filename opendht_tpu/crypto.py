@@ -562,6 +562,9 @@ class Identity:
         yield self.first
         yield self.second
 
+    def __getitem__(self, i):
+        return (self.first, self.second)[i]
+
     def __bool__(self):
         return self.first is not None and self.second is not None
 
