@@ -164,7 +164,8 @@ class NetworkEngine:
                  send_fn: Callable[[bytes, SockAddr], int],
                  scheduler: Scheduler,
                  callbacks: EngineCallbacks,
-                 is_client: bool = False):
+                 is_client: bool = False,
+                 max_req_per_sec: int = MAX_REQUESTS_PER_SEC):
         self.myid = myid
         self.network = network
         self._send_fn = send_fn
@@ -177,7 +178,10 @@ class NetworkEngine:
         self.in_stats = MessageStats()
         self.out_stats = MessageStats()
         self.blacklist: set[SockAddr] = set()
-        self._rate_limiter = RateLimiter(MAX_REQUESTS_PER_SEC)
+        # configurable ingress budget (the reference hardcodes 1600/s
+        # global + 200/s per IP, network_engine.h:424,519-523)
+        self.max_req_per_sec = max(int(max_req_per_sec), 8)
+        self._rate_limiter = RateLimiter(self.max_req_per_sec)
         self._ip_limiters: Dict[tuple, RateLimiter] = {}  # keyed by ip only
         self._limiter_maintenance = 0
 
@@ -284,7 +288,7 @@ class NetworkEngine:
         (1600/s) sliding windows."""
         now = self.scheduler.time()
         self._limiter_maintenance += 1
-        if self._limiter_maintenance == MAX_REQUESTS_PER_SEC // 8:
+        if self._limiter_maintenance == self.max_req_per_sec // 8:
             for key in list(self._ip_limiters):
                 if self._ip_limiters[key].maintain(now) == 0:
                     del self._ip_limiters[key]
@@ -292,7 +296,8 @@ class NetworkEngine:
         key = (addr.family, addr.ip.packed if addr.ip else b"")
         lim = self._ip_limiters.get(key)
         if lim is None:
-            lim = self._ip_limiters[key] = RateLimiter(MAX_REQUESTS_PER_SEC // 8)
+            lim = self._ip_limiters[key] = RateLimiter(
+                self.max_req_per_sec // 8)
         return lim.limit(now) and self._rate_limiter.limit(now)
 
     # ------------------------------------------------------------ rx path

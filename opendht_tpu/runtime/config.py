@@ -56,6 +56,7 @@ class Config:
     is_bootstrap: bool = False       # client mode: don't join tables
     maintain_storage: bool = False   # republish values toward closer nodes
     storage_limit: int = DEFAULT_STORAGE_LIMIT
+    max_req_per_sec: int = 1600      # ingress budget; per-IP = this // 8
 
 
 @dataclass

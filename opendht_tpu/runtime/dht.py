@@ -109,7 +109,8 @@ class Dht:
                 on_announce=self._on_announce,
                 on_refresh=self._on_refresh,
             ),
-            is_client=config.is_bootstrap)
+            is_client=config.is_bootstrap,
+            max_req_per_sec=config.max_req_per_sec)
 
         # TPU-backed routing tables, one per family (↔ buckets4/6,
         # dht.h:370-381)

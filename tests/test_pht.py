@@ -1,0 +1,193 @@
+"""PHT (prefix hash tree) tests — Prefix/Cache unit coverage plus
+insert/lookup scenarios over the in-process virtual network (analog of the
+reference PhtTest suite, python/tools/dht/tests.py:219-368)."""
+
+import pytest
+
+from opendht_tpu.core.value import Value
+from opendht_tpu.indexation.pht import (
+    MAX_NODE_ENTRY_COUNT, Cache, IndexEntry, Pht, Prefix)
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.runtime.config import Config
+
+from virtual_net import VirtualNet
+
+
+# ------------------------------------------------------------------ Prefix
+def test_prefix_basics():
+    p = Prefix(b"\xaa\x55")          # 10101010 01010101
+    assert p.size == 16
+    assert p.is_content_bit_active(0)
+    assert not p.is_content_bit_active(1)
+    assert not p.is_content_bit_active(8)
+    assert p.is_content_bit_active(15)
+
+
+def test_prefix_get_prefix():
+    p = Prefix(b"\xff\x00")
+    q = p.get_prefix(4)
+    assert q.size == 4
+    assert q.content == b"\xf0"
+    r = p.get_prefix(-8)             # size - 8
+    assert r.size == 8 and r.content == b"\xff"
+    with pytest.raises(IndexError):
+        p.get_prefix(17)
+
+
+def test_prefix_sibling():
+    p = Prefix(b"\b0", size=8)
+    p = Prefix(b"\xf0", size=8)
+    s = p.get_sibling()
+    assert s.content == b"\xf1"      # last bit (pos 7) flipped
+    assert p.get_prefix(4).get_sibling().content == b"\xe0"
+
+
+def test_prefix_hash_distinct_by_depth():
+    p = Prefix(b"\xab\xcd")
+    assert p.get_prefix(8).hash() != p.get_prefix(16).hash()
+    assert p.get_prefix(8).hash() == Prefix(b"\xab").hash()
+
+
+def test_common_bits():
+    a = Prefix(b"\xff\x00")
+    b = Prefix(b"\xff\x80")
+    assert Prefix.common_bits(a, b) == 8
+    assert Prefix.common_bits(a, a) == 16
+    c = Prefix(b"\x00\x00")
+    assert Prefix.common_bits(a, c) == 0
+    # capped by the shorter prefix
+    assert Prefix.common_bits(a, b.get_prefix(4)) == 4
+
+
+def test_padding_and_flags():
+    p = Prefix(b"\xab")
+    p.add_padding_content(3)
+    assert len(p.content) == 3
+    # first pad bit is marked to keep "ab" distinct from "ab\0"
+    assert p.is_content_bit_active(8)
+    p.update_flags()
+    # update_flags marks the whole (padded) content known (pht.h:185-199)
+    assert p.is_flag_active(0) and p.is_flag_active(7)
+    assert len(p.flags) == len(p.content)
+
+
+def test_zcurve_interleave():
+    a = Prefix(b"\xff")
+    a.update_flags()
+    b = Prefix(b"\x00")
+    b.update_flags()
+    z = Pht.zcurve([a, b])
+    assert z.size == 16
+    assert z.content == b"\xaa\xaa"  # 1,0 interleaved
+
+
+# ------------------------------------------------------------------- Cache
+def test_cache_insert_lookup():
+    t = {"now": 0.0}
+    c = Cache(clock=lambda: t["now"])
+    assert c.lookup(Prefix(b"\xf0")) == -1
+    c.insert(Prefix(b"\xf0").get_prefix(4))
+    assert c.lookup(Prefix(b"\xf0")) == 4
+    # a diverging key only shares the cached branch partway
+    assert c.lookup(Prefix(b"\x80")) == 1
+    # expiry drops the branch
+    t["now"] = 1000.0
+    assert c.lookup(Prefix(b"\xf0")) == -1
+
+
+# ---------------------------------------------------------------- on-DHT
+def make_net(n=4):
+    # Distinct loopback IPs per node, and a raised ingress budget: the
+    # discrete-event clock compresses whole PHT insert cascades into
+    # fractions of a virtual second, which would (correctly) trip the
+    # default 200 req/s per-IP limiter even though a wall-clock run
+    # would not.
+    net = VirtualNet()
+    cfg = lambda: Config(max_req_per_sec=100_000)
+    seed = net.add_node(cfg(), host="127.0.0.1")
+    for i in range(n - 1):
+        net.add_node(cfg(), host=f"127.0.0.{i + 2}")
+    net.bootstrap_all(seed)
+    assert net.run(90, net.all_connected)
+    return net
+
+
+def do_insert(net, pht, key, value):
+    done = {}
+    pht.insert(key, value, lambda ok: done.update(ok=ok))
+    assert net.run(120, lambda: "ok" in done), "insert never completed"
+    assert done["ok"], "insert failed"
+
+
+def do_lookup(net, pht, key, exact=True):
+    out = {}
+    pht.lookup(key,
+               lambda vals, p: out.update(vals=list(vals), prefix=p),
+               lambda ok: out.update(done=ok), exact_match=exact)
+    assert net.run(120, lambda: "done" in out), "lookup never completed"
+    assert out["done"], "lookup failed"
+    return out.get("vals", [])
+
+
+def test_pht_insert_lookup_single():
+    net = make_net()
+    nodes = list(net.nodes.values())
+    pht = Pht("test", {"name": 4}, nodes[0])
+    key = {"name": b"ab"}
+    target = (InfoHash.get("indexed"), 42)
+    do_insert(net, pht, key, target)
+    vals = do_lookup(net, pht, key)
+    assert target in vals
+
+    # a different key finds nothing (exact match)
+    vals2 = do_lookup(net, pht, {"name": b"zz"})
+    assert target not in vals2
+
+
+def test_pht_lookup_from_other_node():
+    net = make_net()
+    nodes = list(net.nodes.values())
+    pht_a = Pht("shared", {"k": 4}, nodes[0])
+    pht_b = Pht("shared", {"k": 4}, nodes[2])
+    target = (InfoHash.get("val"), 7)
+    do_insert(net, pht_a, {"k": b"key1"}, target)
+    vals = do_lookup(net, pht_b, {"k": b"key1"})
+    assert target in vals
+
+
+def test_pht_multiple_entries_same_key():
+    net = make_net()
+    nodes = list(net.nodes.values())
+    pht = Pht("multi", {"k": 2}, nodes[1])
+    key = {"k": b"xy"}
+    targets = [(InfoHash.get(f"v{i}"), i + 1) for i in range(4)]
+    for t in targets:
+        do_insert(net, pht, key, t)
+    vals = do_lookup(net, pht, key)
+    for t in targets:
+        assert t in vals
+
+
+def test_pht_split_beyond_node_capacity():
+    """More than MAX_NODE_ENTRY_COUNT distinct keys forces a leaf split;
+    everything must stay findable afterwards."""
+    net = make_net(3)
+    nodes = list(net.nodes.values())
+    pht = Pht("split", {"k": 2}, nodes[0])
+    n = MAX_NODE_ENTRY_COUNT + 3
+    pairs = [({"k": bytes([i, 255 - i])}, (InfoHash.get(f"s{i}"), i + 1))
+             for i in range(n)]
+    for key, target in pairs:
+        do_insert(net, pht, key, target)
+    # spot-check across the key space, including both extremes
+    for key, target in [pairs[0], pairs[n // 2], pairs[-1]]:
+        vals = do_lookup(net, pht, key)
+        assert target in vals, f"lost {key} after split"
+
+
+def test_index_entry_roundtrip():
+    e = IndexEntry(b"\xab\xcd", (InfoHash.get("x"), 99), "index.pht.t")
+    v = e.pack()
+    assert v.user_type == "index.pht.t"
+    e2 = IndexEntry.unpack(v)
+    assert e2.prefix == e.prefix and e2.value == e.value
