@@ -94,6 +94,16 @@ class DhtRunner:
         self.status_cb: Optional[Callable] = None
         self.on_status_changed: Optional[Callable] = None
 
+        # proxy hot-swap state (↔ dhtrunner.cpp:992-1041)
+        self.use_proxy = False
+        self._proxy_dht = None                 # SecureDht over DhtProxyClient
+        self._proxy_client = None
+        self._listeners_lock = threading.Lock()
+        self._listener_token = 1
+        #: runner token → _RunnerListener (↔ DhtRunner::Listener,
+        #: dhtrunner.cpp:47-54: {tokenClassicDht, tokenProxyDht, key, cb, f})
+        self._listeners: dict = {}
+
     # ------------------------------------------------------------- lifecycle
     def run(self, port: int = 0, config: Optional[RunnerConfig] = None,
             *, ipv6: bool = False) -> None:
@@ -195,16 +205,18 @@ class DhtRunner:
                 ops = list(self._pending_ops_prio)
                 self._pending_ops_prio.clear()
             elif self._pending_ops and (
-                    status is NodeStatus.CONNECTED
+                    self.use_proxy
+                    or status is NodeStatus.CONNECTED
                     or (status is NodeStatus.DISCONNECTED
                         and not self._bootstraping)):
                 ops = list(self._pending_ops)
                 self._pending_ops.clear()
             else:
                 ops = []
+        active = self._proxy_dht if self.use_proxy else dht
         for op in ops:
             try:
-                op(dht)
+                op(active)
             except Exception:
                 log.exception("pending op failed")
 
@@ -261,6 +273,8 @@ class DhtRunner:
                     if self._pending_ops_prio:
                         return True
                     if self._pending_ops:
+                        if self.use_proxy:
+                            return True
                         s = self.get_status()
                         if s is NodeStatus.CONNECTED or (
                                 s is NodeStatus.DISCONNECTED
@@ -281,6 +295,12 @@ class DhtRunner:
         return self._loop()
 
     # ------------------------------------------------------------- op queues
+    def _post_node(self, op, prio: bool = False) -> None:
+        """Post an op that must run on the UDP node even while the proxy
+        backend is active (node-level ops: ping/insert/export — the REST
+        backend has no node table)."""
+        self._post(lambda _active: op(self._dht), prio)
+
     def _post(self, op, prio: bool = False) -> None:
         with self._ops_lock:
             (self._pending_ops_prio if prio else self._pending_ops).append(op)
@@ -302,7 +322,8 @@ class DhtRunner:
     def bootstrap_node(self, node_id: InfoHash, addr: SockAddr) -> None:
         """Insert a known node directly (no ping) — import path
         (dhtrunner.cpp:933-947)."""
-        self._post(lambda dht: dht.insert_node(node_id, addr), prio=True)
+        self._post_node(lambda dht: dht.insert_node(node_id, addr),
+                        prio=True)
 
     def _ping(self, hostport: Tuple[str, int], done_cb=None) -> None:
         host, port = hostport
@@ -311,7 +332,8 @@ class DhtRunner:
         except OSError:
             addrs = []
         for a in addrs:
-            self._post(lambda dht, a=a: dht.ping_node(a, done_cb), prio=True)
+            self._post_node(lambda dht, a=a: dht.ping_node(a, done_cb),
+                            prio=True)
 
     def _try_bootstrap_continuously(self) -> None:
         """(↔ tryBootstrapContinuously, dhtrunner.cpp:819-875)"""
@@ -387,19 +409,129 @@ class DhtRunner:
 
     def listen(self, key: InfoHash, cb, f=None,
                where=None) -> concurrent.futures.Future:
-        """Returns a Future resolving to the listen token
-        (↔ DhtRunner::listen futures, dhtrunner.cpp:638-671)."""
+        """Returns a Future resolving to the (runner-level) listen token
+        (↔ DhtRunner::listen futures, dhtrunner.cpp:638-671).  The runner
+        keeps the listener record so subscriptions survive a proxy
+        hot-swap (↔ DhtRunner::Listener, dhtrunner.cpp:47-54)."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        self._post(lambda dht: fut.set_result(
-            dht.listen(key, cb, f, where)))
+
+        # Dedup wrapper: a backend swap replays current values on the new
+        # subscription; remember what this runner-level listener already
+        # delivered so user callbacks fire once per value (the role the
+        # reference's per-listener OpValueCache plays).
+        seen: dict = {}
+
+        def wrapped_cb(values, expired):
+            out = []
+            for v in values:
+                if expired:
+                    seen.pop(v.id, None)
+                    out.append(v)
+                else:
+                    prev = seen.get(v.id)
+                    if prev is not None and prev == v:
+                        continue
+                    seen[v.id] = v
+                    out.append(v)
+            if not out:
+                return True
+            return cb(out, expired)
+
+        def op(dht):
+            backend_token = dht.listen(key, wrapped_cb, f, where)
+            with self._listeners_lock:
+                token = self._listener_token
+                self._listener_token += 1
+                self._listeners[token] = {
+                    "key": key, "cb": wrapped_cb, "f": f, "where": where,
+                    "backend_token": backend_token,
+                    "on_proxy": self.use_proxy,
+                }
+            fut.set_result(token)
+
+        self._post(op)
         return fut
 
     def cancel_listen(self, key: InfoHash, token) -> None:
-        if isinstance(token, concurrent.futures.Future):
-            tok_fut = token
-            self._post(lambda dht: dht.cancel_listen(key, tok_fut.result(0)))
-        else:
-            self._post(lambda dht: dht.cancel_listen(key, token))
+        def op(dht):
+            t = (token.result(0)
+                 if isinstance(token, concurrent.futures.Future) else token)
+            with self._listeners_lock:
+                rec = self._listeners.pop(t, None)
+            if rec is not None:
+                dht.cancel_listen(rec["key"], rec["backend_token"])
+            # unknown runner tokens are dropped: forwarding them into the
+            # backend token namespace could cancel someone else's listener
+
+        self._post(op)
+
+    # ----------------------------------------------------------- proxy swap
+    def enable_proxy(self, proxy: "str | None") -> None:
+        """Hot-swap the backend between the UDP node and a REST proxy
+        client, re-registering every live listener on the new backend
+        (↔ DhtRunner::enableProxy, dhtrunner.cpp:992-1041).
+
+        ``proxy`` is "host:port" (or "http://host:port") to enable,
+        None/"" to fall back to the UDP node.
+        """
+        def op(_dht):
+            from ..proxy.client import DhtProxyClient
+
+            old = self._proxy_dht if self.use_proxy else self._dht
+            old_client = self._proxy_client
+            if proxy:
+                spec = proxy
+                for prefix in ("http://", "https://"):
+                    if spec.startswith(prefix):
+                        spec = spec[len(prefix):]
+                spec = spec.rstrip("/")
+                # host[:port], [v6]:port, bare v6 literal, bare host
+                if spec.startswith("["):                   # [::1]:8080
+                    host, _, rest = spec[1:].partition("]")
+                    port_s = rest.lstrip(":")
+                elif spec.count(":") == 1:                 # host:port
+                    host, _, port_s = spec.partition(":")
+                else:                                      # bare host / v6
+                    host, port_s = spec, ""
+                try:
+                    port_n = int(port_s) if port_s else 8080
+                except ValueError:
+                    log.error("enable_proxy: invalid proxy spec %r", proxy)
+                    return
+                client = DhtProxyClient(host or "127.0.0.1", port_n,
+                                        client_id=self._config.push_node_id)
+                ident = self._config.identity
+                new = SecureDht(client,
+                                (ident.first, ident.second) if ident else None)
+                self._proxy_client = client
+                self._proxy_dht = new
+                self.use_proxy = True
+            else:
+                if not self.use_proxy:
+                    return
+                new = self._dht
+                self.use_proxy = False
+            # re-register listeners on the new backend (:1005-1032)
+            with self._listeners_lock:
+                recs = list(self._listeners.values())
+            for rec in recs:
+                try:
+                    old.cancel_listen(rec["key"], rec["backend_token"])
+                except Exception:
+                    pass
+                rec["backend_token"] = new.listen(
+                    rec["key"], rec["cb"], rec["f"], rec["where"])
+                rec["on_proxy"] = self.use_proxy
+            # retire the previous proxy client (proxy→proxy swap or
+            # fall-back to UDP): stop its maintenance/long-poll threads
+            if old_client is not None and old_client is not self._proxy_client:
+                old_client.join()
+            if not proxy and self._proxy_client is not None:
+                self._proxy_client.join()
+                self._proxy_client = None
+                self._proxy_dht = None
+
+        self._post(op, prio=True)
 
     def find_certificate(self, node: InfoHash, cb) -> None:
         self._post(lambda dht: dht.find_certificate(node, cb))
@@ -409,7 +541,10 @@ class DhtRunner:
 
     # ----------------------------------------------------------- inspection
     def get_status(self, af: int = 0) -> NodeStatus:
-        """Best status across families (dhtrunner.h:165-172)."""
+        """Best status across families (dhtrunner.h:165-172); when the
+        proxy backend is active, its connectivity is the node's status."""
+        if self.use_proxy and self._proxy_dht is not None:
+            return self._proxy_dht.get_status(af)
         if af == _socket.AF_INET:
             return self.status4
         if af == _socket.AF_INET6:
@@ -437,16 +572,18 @@ class DhtRunner:
 
     def export_nodes(self) -> list:
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        self._post(lambda dht: fut.set_result(dht.export_nodes()), prio=True)
+        self._post_node(lambda dht: fut.set_result(dht.export_nodes()),
+                        prio=True)
         return fut.result(10.0)
 
     def export_values(self) -> list:
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        self._post(lambda dht: fut.set_result(dht.export_values()), prio=True)
+        self._post_node(lambda dht: fut.set_result(dht.export_values()),
+                        prio=True)
         return fut.result(10.0)
 
     def import_values(self, values: list) -> None:
-        self._post(lambda dht: dht.import_values(values), prio=True)
+        self._post_node(lambda dht: dht.import_values(values), prio=True)
 
     # ------------------------------------------------------------- shutdown
     def shutdown(self, cb=None) -> None:
@@ -485,4 +622,9 @@ class DhtRunner:
         with self._ops_lock:
             self._pending_ops.clear()
             self._pending_ops_prio.clear()
+        if self._proxy_client is not None:
+            self._proxy_client.join()
+            self._proxy_client = None
+            self._proxy_dht = None
+        self.use_proxy = False
         self._dht = None

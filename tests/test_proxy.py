@@ -1,0 +1,187 @@
+"""REST proxy tests — the analog of the reference tests/dhtproxytester.cpp
+(:34-60): a peer node, a proxy node carrying a DhtProxyServer, and a
+DhtProxyClient doing get/put/listen through REST, plus JSON-codec unit
+round-trips and the SecureDht-over-proxy path."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from opendht_tpu import crypto
+from opendht_tpu.core.value import Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.proxy import (
+    DhtProxyClient, DhtProxyServer, value_from_json, value_to_json,
+)
+from opendht_tpu.runtime.config import NodeStatus
+from opendht_tpu.runtime.runner import DhtRunner, RunnerConfig
+from opendht_tpu.runtime.secure_dht import SecureDht
+
+
+def wait_for(pred, timeout=20.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def topology():
+    """peer node ↔ proxy node + DhtProxyServer + DhtProxyClient
+    (dhtproxytester.cpp:34-60, minus the separate client node)."""
+    peer, proxy_node = DhtRunner(), DhtRunner()
+    peer.run(0)
+    proxy_node.run(0)
+    proxy_node.bootstrap("127.0.0.1", peer.get_bound_port())
+    assert wait_for(lambda: peer.get_status() is NodeStatus.CONNECTED
+                    and proxy_node.get_status() is NodeStatus.CONNECTED)
+    server = DhtProxyServer(proxy_node, port=0)
+    client = DhtProxyClient("127.0.0.1", server.port)
+    yield peer, proxy_node, server, client
+    client.join()
+    server.stop()
+    peer.join()
+    proxy_node.join()
+
+
+# ---------------------------------------------------------------- unit: codec
+
+def test_json_roundtrip_plain():
+    v = Value(b"hello world", type_id=3, value_id=42, user_type="text/plain")
+    v2 = value_from_json(value_to_json(v))
+    assert v2.id == 42 and v2.data == b"hello world"
+    assert v2.type == 3 and v2.user_type == "text/plain"
+
+
+def test_json_roundtrip_signed():
+    ident = crypto.generate_identity("codec-test", key_length=1024)
+    v = Value(b"signed payload", value_id=7)
+    v.sign(ident.first)
+    obj = value_to_json(v)
+    assert "sig" in obj and "owner" in obj
+    v2 = value_from_json(obj)
+    assert v2.data == b"signed payload"
+    assert v2.check_signature()
+
+
+def test_json_roundtrip_encrypted():
+    v = Value(value_id=9)
+    v.cypher = b"\x01\x02\x03"
+    v2 = value_from_json(value_to_json(v))
+    assert v2.is_encrypted() and v2.cypher == b"\x01\x02\x03"
+
+
+# ------------------------------------------------------------------ rest api
+
+def test_node_info(topology):
+    peer, proxy_node, server, client = topology
+    info = client.get_proxy_info()
+    assert info is not None
+    assert info["node_id"] == proxy_node.get_node_id().hex()
+    assert "ipv4" in info
+    assert wait_for(lambda: client.get_status() is NodeStatus.CONNECTED,
+                    timeout=25.0)
+
+
+def test_put_via_proxy_get_via_udp(topology):
+    peer, proxy_node, server, client = topology
+    key = InfoHash.get("proxy-put-key")
+    done = []
+    client.put(key, Value(b"via-proxy", value_id=11),
+               lambda ok, ns: done.append(ok))
+    assert wait_for(lambda: bool(done)) and done[0]
+    vals = peer.get_sync(key, timeout=20.0)
+    assert any(v.data == b"via-proxy" for v in vals)
+
+
+def test_put_via_udp_get_via_proxy(topology):
+    peer, proxy_node, server, client = topology
+    key = InfoHash.get("proxy-get-key")
+    assert peer.put_sync(key, Value(b"via-udp", value_id=12), timeout=20.0)
+    vals = client.get_sync(key, timeout=20.0)
+    assert any(v.data == b"via-udp" for v in vals)
+
+
+def test_get_specific_value_id(topology):
+    peer, proxy_node, server, client = topology
+    key = InfoHash.get("proxy-vid-key")
+    assert peer.put_sync(key, Value(b"one", value_id=21), timeout=20.0)
+    assert peer.put_sync(key, Value(b"two", value_id=22), timeout=20.0)
+    url = "http://127.0.0.1:%d/%s/22" % (server.port, key.hex())
+    with urllib.request.urlopen(url, timeout=20.0) as r:
+        lines = [json.loads(l) for l in r.read().decode().splitlines() if l.strip()]
+    assert lines and all(int(o["id"]) == 22 for o in lines)
+
+
+def test_listen_via_proxy(topology):
+    peer, proxy_node, server, client = topology
+    key = InfoHash.get("proxy-listen-key")
+    heard = []
+    token = client.listen(key, lambda vals, expired:
+                          heard.extend(v.data for v in vals) or True)
+    time.sleep(1.0)                      # let the long-poll attach
+    assert peer.put_sync(key, Value(b"pushed", value_id=31), timeout=20.0)
+    assert wait_for(lambda: b"pushed" in heard, timeout=25.0), heard
+    assert client.cancel_listen(key, token)
+
+
+def test_stats_endpoint(topology):
+    peer, proxy_node, server, client = topology
+    st = client._request_json("STATS", "/")
+    assert st is not None
+    assert "putCount" in st and "listenCount" in st and "nodeInfo" in st
+
+
+def test_runner_enable_proxy_hotswap(topology):
+    """A third runner switches its backend to the REST proxy, ops and the
+    live listener carry over, then it swaps back (dhtrunner.cpp:992-1041,
+    dhtproxytester.cpp client-node role)."""
+    peer, proxy_node, server, client = topology
+    c = DhtRunner()
+    c.run(0)
+    try:
+        heard = []
+        key = InfoHash.get("hotswap-listen")
+        tok = c.listen(key, lambda vals, expired:
+                       heard.extend(v.data for v in vals) or True)
+        tok.result(10.0)
+
+        c.enable_proxy("127.0.0.1:%d" % server.port)
+        assert wait_for(lambda: c.use_proxy, timeout=10.0)
+        assert wait_for(lambda: c.get_status() is NodeStatus.CONNECTED,
+                        timeout=25.0)
+        key2 = InfoHash.get("hotswap-put")
+        assert c.put_sync(key2, Value(b"over-proxy", value_id=51),
+                          timeout=25.0)
+        vals = peer.get_sync(key2, timeout=20.0)
+        assert any(v.data == b"over-proxy" for v in vals)
+
+        # the pre-swap listener must now ride the proxy long-poll
+        time.sleep(1.0)
+        assert peer.put_sync(key, Value(b"carried", value_id=52), timeout=20.0)
+        assert wait_for(lambda: b"carried" in heard, timeout=25.0), heard
+
+        c.enable_proxy(None)
+        assert wait_for(lambda: not c.use_proxy, timeout=10.0)
+    finally:
+        c.join()
+
+
+def test_secure_dht_over_proxy(topology):
+    """SecureDht wrapping the REST backend: signed put through the proxy,
+    verified via UDP get (↔ the reference's SecureDhtProxy stack)."""
+    peer, proxy_node, server, client = topology
+    ident = crypto.generate_identity("proxy-sec", key_length=1024)
+    sdht = SecureDht(client, (ident.first, ident.second))
+    key = InfoHash.get("proxy-signed-key")
+    done = []
+    sdht.put_signed(key, Value(b"signed-over-rest", value_id=41),
+                    lambda ok, ns: done.append(ok))
+    assert wait_for(lambda: bool(done), timeout=25.0) and done[0]
+    vals = peer.get_sync(key, timeout=20.0)
+    got = [v for v in vals if v.data == b"signed-over-rest"]
+    assert got and got[0].is_signed() and got[0].check_signature()
