@@ -179,6 +179,21 @@ class Dht:
                 log.exception("can't process message from %r", from_addr)
         return self.scheduler.run()
 
+    def warmup(self) -> None:
+        """Trigger the XLA compiles of the hot table kernels (snapshot
+        sort, windowed top-k) so the first real packet doesn't stall the
+        protocol thread behind a multi-second first-compile.  The top-k
+        kernel is specialized per static ``k``, so warm every k the live
+        path uses.  Compiled executables are cached per-process."""
+        now = self.scheduler.time()
+        target = [InfoHash.get_random()]
+        for table in self.tables.values():
+            try:
+                for k in (TARGET_NODES, SEARCH_NODES):
+                    table.find_closest(target, k=k, now=now)
+            except Exception:
+                log.debug("kernel warmup failed", exc_info=True)
+
     # ======================================================== routing plumbing
     def find_closest_nodes(self, target: InfoHash, af: int,
                            count: int = TARGET_NODES) -> List[Node]:

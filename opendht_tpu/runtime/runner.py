@@ -122,6 +122,7 @@ class DhtRunner:
                   has_v4=True, has_v6=ipv6 and self._sock6 is not None)
         self._dht = SecureDht(dht, config.identity)
         dht.status_cb = lambda s4, s6: None   # runner tracks status itself
+        dht.warmup()     # compile hot kernels before serving any packet
 
         self.running = True
         if not config.threaded:

@@ -1,0 +1,51 @@
+"""Tools-layer tests: argv/identity helpers and the non-interactive
+pieces of dhtnode/dhtchat/dhtscanner (the interactive REPL is driven in
+CI-style smoke runs, not here)."""
+
+import os
+
+import pytest
+
+from opendht_tpu import crypto
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.tools.common import (load_identity, make_arg_parser,
+                                      parse_bootstrap, save_identity)
+from opendht_tpu.tools.dhtnode import to_hash
+
+
+def test_parse_bootstrap_forms():
+    assert parse_bootstrap("") is None
+    assert parse_bootstrap("host") == ("host", 4222)
+    assert parse_bootstrap("host:4000") == ("host", 4000)
+    assert parse_bootstrap("[2001:db8::1]:4000") == ("2001:db8::1", 4000)
+    assert parse_bootstrap("[2001:db8::1]") == ("2001:db8::1", 4222)
+    assert parse_bootstrap("2001:db8::1") == ("2001:db8::1", 4222)
+
+
+def test_to_hash_hex_vs_text():
+    h = InfoHash.get("x")
+    assert to_hash(h.hex()) == h                 # 40-hex passes through
+    assert to_hash("some words") == InfoHash.get("some words")
+
+
+def test_identity_save_load(tmp_path):
+    ident = crypto.generate_identity("tools-test", key_length=1024)
+    prefix = str(tmp_path / "id")
+    save_identity(ident, prefix)
+    assert os.path.exists(prefix + ".pem")
+    assert os.path.exists(prefix + ".crt")
+    loaded = load_identity(prefix)
+    assert loaded is not None
+    assert loaded.second.get_id() == ident.second.get_id()
+    # loaded key can still sign for the same public key
+    sig = loaded.first.sign(b"data")
+    assert ident.first.public_key().check_signature(b"data", sig)
+
+
+def test_arg_parser_defaults():
+    args = make_arg_parser("t").parse_args([])
+    assert args.port == 0 and args.bootstrap == "" and not args.identity
+    args = make_arg_parser("t").parse_args(
+        ["-p", "4222", "-b", "h:1", "-i", "--proxyserver", "8080"])
+    assert (args.port, args.bootstrap, args.identity, args.proxyserver) == \
+        (4222, "h:1", True, 8080)
