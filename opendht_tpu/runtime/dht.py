@@ -587,6 +587,16 @@ class Dht:
                 continue
             if not any(sn.get_announce_time(a.value.id) <= now
                        for a in sr.announce):
+                # already announced/pending on this node: it still occupies
+                # one of the k replica slots — count it so the walk can't
+                # drift past the 8 closest while acks are in flight (the
+                # reference skips without counting, dht.cpp:391-395, which
+                # over-replicates under fast stepping; k-closest semantics
+                # per routing_table.h:26)
+                if not sn.candidate:
+                    i += 1
+                    if i == TARGET_NODES:
+                        break
                 continue
 
             def on_put_done(req: Request, answer: RequestAnswer):

@@ -63,13 +63,45 @@ class VirtualNet:
         self.nodes[key] = dht
         return dht
 
+    def bootstrap_node(self, dht: Dht, seed_node: Dht) -> None:
+        """Point one node at the seed and ping it (↔ the runner's
+        bootstrap thread, reference src/dhtrunner.cpp:819-875)."""
+        dht.insert_node(seed_node.myid, seed_node.bound_addr)
+        dht.ping_node(seed_node.bound_addr)
+
+    def remove_node(self, dht: Dht) -> None:
+        """Kill a node: it stops receiving and its scheduler stops running
+        (↔ DhtNetworkSubProcess node shutdown, reference
+        python/tools/dht/network.py:377-436)."""
+        key = (dht.bound_addr.host, dht.bound_addr.port)
+        self.nodes.pop(key, None)
+
+    def replace_cluster(self, count: int, seed_node: Dht,
+                        config: Optional[Config] = None) -> List[Dht]:
+        """Kill ``count`` random nodes (never the seed) and start as many
+        fresh ones bootstrapped at the seed (↔ the reference's cluster
+        replacement during PerformanceTest rounds, dht/tests.py:905-910)."""
+        candidates = [d for d in self.nodes.values() if d is not seed_node]
+        victims = self.rng.sample(candidates, min(count, len(candidates)))
+        for v in victims:
+            self.remove_node(v)
+        fresh = []
+        for _ in victims:
+            d = self.add_node(config)
+            self.bootstrap_node(d, seed_node)
+            fresh.append(d)
+        return fresh
+
+    def storers_of(self, key) -> List[Dht]:
+        """Nodes currently holding values for ``key`` locally."""
+        return [d for d in self.nodes.values() if d.get_local(key)]
+
     def bootstrap_all(self, seed_node: Dht) -> None:
         """Point every other node at the seed and ping it (↔ the runner's
         bootstrap thread, reference src/dhtrunner.cpp:819-875)."""
         for dht in self.nodes.values():
             if dht is not seed_node:
-                dht.insert_node(seed_node.myid, seed_node.bound_addr)
-                dht.ping_node(seed_node.bound_addr)
+                self.bootstrap_node(dht, seed_node)
 
     # ------------------------------------------------------------ event loop
     def _next_event_time(self) -> float:

@@ -14,7 +14,7 @@ from opendht_tpu.core.value import Query, Select, Value, Where, Field
 from opendht_tpu.runtime import Config, Dht, NodeStatus
 from opendht_tpu.sockaddr import SockAddr
 
-from virtual_net import VirtualNet
+from opendht_tpu.testing import VirtualNet
 
 
 def make_net(n: int, **kw) -> VirtualNet:

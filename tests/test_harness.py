@@ -1,0 +1,54 @@
+"""Cluster-harness tests (↔ the reference's tier-3 suites,
+python/tools/dht/tests.py run at CI scale): latency rounds with churn,
+the node-kill delete test, and maintain_storage persistence — all on the
+deterministic virtual clock."""
+
+from opendht_tpu.core.value import Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.runtime.config import Config
+from opendht_tpu.testing import PerformanceTest, PersistenceTest
+from opendht_tpu.testing.scenarios import build_net
+
+
+def test_gets_times_with_replacement():
+    net = build_net(12, seed=5)
+    stats = PerformanceTest(net, seed=5).gets_times(
+        rounds=2, gets_per_round=6, replace=2, config=Config())
+    s = stats.summary()
+    assert s["count"] == 12
+    assert 0 < s["mean"] < 5.0          # virtual seconds
+    assert s["min"] > 0
+
+def test_replication_is_k_closest():
+    """A put lands on exactly the 8 XOR-closest nodes (+ the putter's
+    local store) — the k=8 replica invariant (routing_table.h:26)."""
+    net = build_net(16, seed=2)
+    key = InfoHash.get("replication-check")
+    nodes = list(net.nodes.values())
+    done = []
+    nodes[-1].put(key, Value(b"x"), lambda ok, ns: done.append(ok))
+    assert net.run(max_time=30.0, until=lambda: bool(done))
+    holders = set(map(id, net.storers_of(key)))
+    ranked = sorted(nodes, key=lambda d: bytes(
+        a ^ b for a, b in zip(bytes(d.myid), bytes(key))))
+    closest8 = set(map(id, ranked[:8]))
+    assert closest8 <= holders
+    assert len(holders) <= 10           # 8 + putter (+1 sync-drift slack)
+
+
+def test_delete_reports_holders():
+    net = build_net(10, seed=3)
+    survived, holders = PerformanceTest(net, seed=3).delete_test()
+    assert holders >= 8                 # value was replicated before kill
+    # with every holder gone at once and no republication configured the
+    # value is usually lost; the scenario reports rather than asserts —
+    # here we only require the harness executed end-to-end
+    assert isinstance(survived, bool)
+
+
+def test_persistence_under_churn():
+    conf = Config(maintain_storage=True)
+    net = build_net(14, seed=4, config=conf)
+    ok = PersistenceTest(net, seed=4).churn_survival(
+        kills=3, between=700.0, config=conf)
+    assert ok

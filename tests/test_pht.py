@@ -10,7 +10,7 @@ from opendht_tpu.indexation.pht import (
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.runtime.config import Config
 
-from virtual_net import VirtualNet
+from opendht_tpu.testing import VirtualNet
 
 
 # ------------------------------------------------------------------ Prefix

@@ -12,7 +12,7 @@ from opendht_tpu.runtime.config import Config
 from opendht_tpu.runtime.secure_dht import (
     CERTIFICATE_TYPE, SecureDht, secure_node_id)
 
-from virtual_net import VirtualNet
+from opendht_tpu.testing import VirtualNet
 
 
 @pytest.fixture(scope="module")
