@@ -144,13 +144,26 @@ class Storage:
             bucket.insert(key, value, expiration)
         return vs, StoreDiff(sz, 1, 0)
 
-    def refresh(self, now: float, vid: int) -> bool:
-        """Restart a value's lifetime (storage.h:159-166)."""
+    def refresh(self, now: float, vid: int, key: InfoHash
+                ) -> Optional[float]:
+        """Restart a value's lifetime (storage.h:159-166).  The reference
+        recomputes expiry from ``created`` at sweep time; we cache the
+        absolute expiration, so the refresh must extend it (and re-index
+        the per-IP quota bucket, which is expiration-sorted).
+
+        Returns the new absolute expiration (the caller must schedule an
+        expiry sweep at that time), or None if the value is unknown."""
         for vs in self.values:
             if vs.data.id == vid:
+                ttl = vs.expiration - vs.created
+                if vs.store_bucket is not None:
+                    vs.store_bucket.erase(key, vs.data, vs.expiration)
                 vs.created = now
-                return True
-        return False
+                vs.expiration = now + ttl
+                if vs.store_bucket is not None:
+                    vs.store_bucket.insert(key, vs.data, vs.expiration)
+                return vs.expiration
+        return None
 
     def remove(self, key: InfoHash, vid: int) -> StoreDiff:
         """(storage.h:222-238)"""

@@ -91,11 +91,17 @@ def _lower_bound(sorted_ids, queries, n_valid):
     return lo
 
 
-@functools.partial(jax.jit, static_argnames=("k", "window"))
-def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128):
+@functools.partial(jax.jit, static_argnames=("k", "window", "select"))
+def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
+                select: str = "auto"):
     """k XOR-closest among the first n_valid rows of a sorted table,
     searched only within a `window`-wide slice around each query's
     sorted position, plus a per-query exactness certificate.
+
+    ``select`` picks the in-window top-k engine: ``"sort"`` = 7-key
+    ``lax.sort``; ``"pallas"`` = the VPU min-extraction kernel
+    (ops/pallas_select.py); ``"auto"`` = pallas on TPU, sort elsewhere.
+    Both are exact and bit-identical (tests/test_topk.py).
 
     Returns:
       dist      [Q, k, 5] uint32 (all-ones beyond n_valid results)
@@ -104,6 +110,8 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128):
     """
     if window < k:
         raise ValueError(f"window ({window}) must be >= k ({k})")
+    if select == "auto":
+        select = "pallas" if jax.default_backend() == "tpu" else "sort"
     N = sorted_ids.shape[0]
     Q = queries.shape[0]
     n_valid = jnp.asarray(n_valid, jnp.int32)
@@ -119,17 +127,31 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128):
     win_ids = jnp.take(sorted_ids, gidx.reshape(-1), axis=0).reshape(Q, window, N_LIMBS)
 
     dist = xor_ids(queries[:, None, :], win_ids)
-    ops_in = (
-        inv,
-        dist[..., 0], dist[..., 1], dist[..., 2], dist[..., 3], dist[..., 4],
-        raw,
-    )
-    out = lax.sort(ops_in, dimension=1, num_keys=7)
-    top_inv = out[0][:, :k]
-    top_dist = jnp.stack(out[1:6], axis=-1)[:, :k]
-    top_idx = jnp.where(top_inv == 0, out[6][:, :k], -1)
-    top_dist = jnp.where((top_inv == 0)[..., None], top_dist,
-                         jnp.full_like(top_dist, 0xFFFFFFFF))
+    if select == "pallas":
+        from .pallas_select import lex_topk_select
+        sel = lex_topk_select(dist, inv, k=k,
+                              interpret=jax.default_backend() != "tpu")
+        found = sel >= 0
+        selc = jnp.clip(sel, 0, window - 1)
+        top_inv = (~found).astype(jnp.int32)
+        top_idx = jnp.where(found, jnp.take_along_axis(raw, selc, axis=1), -1)
+        top_dist = jnp.where(
+            found[..., None],
+            jnp.take_along_axis(dist, selc[..., None], axis=1),
+            jnp.uint32(0xFFFFFFFF))
+    else:
+        ops_in = (
+            inv,
+            dist[..., 0], dist[..., 1], dist[..., 2], dist[..., 3],
+            dist[..., 4],
+            raw,
+        )
+        out = lax.sort(ops_in, dimension=1, num_keys=7)
+        top_inv = out[0][:, :k]
+        top_dist = jnp.stack(out[1:6], axis=-1)[:, :k]
+        top_idx = jnp.where(top_inv == 0, out[6][:, :k], -1)
+        top_dist = jnp.where((top_inv == 0)[..., None], top_dist,
+                             jnp.full_like(top_dist, 0xFFFFFFFF))
 
     # ---- exactness certificate ------------------------------------------
     # Nodes excluded on the left are all at sorted index < start; the

@@ -121,6 +121,8 @@ class Dht:
         # sorted key lists for trySearchInsert's bidirectional walk
         self._search_keys: Dict[int, List[bytes]] = {af: [] for af in self.tables}
         self._search_id = random.randint(1, 0xFFFF)
+        #: (key, vid) → live local-refresh Job for permanent puts
+        self._local_refresh_jobs: Dict[tuple, object] = {}
 
         # value store (↔ dht.h:372-377)
         self.store: Dict[InfoHash, Storage] = {}
@@ -431,6 +433,18 @@ class Dht:
             log.warning("[search %s] expired", sr.id)
             sr.expire()
             self.connectivity_changed(sr.af)
+            return
+
+        # self-reschedule at the next announce/listen refresh so permanent
+        # puts and listens refresh before remote expiry even when no other
+        # traffic steps this search (live_search.Search.get_next_step_time)
+        nxt = sr.get_next_step_time(now)
+        if nxt < TIME_MAX:
+            job = sr.next_search_step
+            pending = job.time if (job is not None
+                                   and not job.cancelled) else None
+            if pending is None or nxt < pending:
+                self._edit_step(sr, nxt)
 
     def _search_send_get_values(self, sr: Search,
                                 pn: Optional[SearchNode] = None,
@@ -871,6 +885,42 @@ class Dht:
         if done_cb and not state["done"] and state["done4"] and state["done6"]:
             state["done"] = True
             done_cb(state["ok4"] or state["ok6"], [])
+        if permanent:
+            self._schedule_local_refresh(key, value)
+
+    def _schedule_local_refresh(self, key: InfoHash, value: Value) -> None:
+        """Keep the *local* copy of a permanent put alive: remote copies
+        are refreshed by the announce path (send_refresh_value), but the
+        putter's own storage would hit its TTL otherwise.  Runs until the
+        permanent announce is cancelled on every family.  One chain per
+        (key, vid) — re-puts of the same value reuse the live chain."""
+        ttl = self.types.get_type(value.type).expiration
+        vid = value.id
+        if (key, vid) in self._local_refresh_jobs:
+            return
+
+        def local_refresh():
+            still = any(
+                a.permanent and a.value.id == vid
+                for srs in self.searches.values()
+                for sr in ((srs.get(key),) if srs.get(key) else ())
+                for a in sr.announce)
+            if not still:
+                self._local_refresh_jobs.pop((key, vid), None)
+                return
+            st = self.store.get(key)
+            new_exp = (st.refresh(self.scheduler.time(), vid, key)
+                       if st is not None else None)
+            if new_exp is not None:
+                self.scheduler.add(new_exp,
+                                   lambda: self._expire_storage(key))
+            self._local_refresh_jobs[(key, vid)] = self.scheduler.add(
+                self.scheduler.time() + max(ttl - REANNOUNCE_MARGIN, 1.0),
+                local_refresh)
+
+        self._local_refresh_jobs[(key, vid)] = self.scheduler.add(
+            self.scheduler.time() + max(ttl - REANNOUNCE_MARGIN, 1.0),
+            local_refresh)
 
     def _announce(self, key: InfoHash, af: int, value: Value, callback,
                   created: Optional[float], permanent: bool) -> None:
@@ -1293,9 +1343,14 @@ class Dht:
             raise DhtProtocolException(DhtProtocolException.UNAUTHORIZED,
                                        DhtProtocolException.PUT_WRONG_TOKEN)
         st = self.store.get(key)
-        if st is None or not st.refresh(self.scheduler.time(), vid):
+        new_exp = (st.refresh(self.scheduler.time(), vid, key)
+                   if st is not None else None)
+        if new_exp is None:
             raise DhtProtocolException(DhtProtocolException.NOT_FOUND,
                                        DhtProtocolException.STORAGE_NOT_FOUND)
+        # the sweep scheduled at the original expiration will now keep the
+        # value; cover the extended lifetime with a new sweep
+        self.scheduler.add(new_exp, lambda: self._expire_storage(key))
         return RequestAnswer()
 
     # ============================================================ maintenance

@@ -474,6 +474,45 @@ class Search:
             sn.acked.clear()
         self.done = True
 
+    def get_next_step_time(self, now: float) -> float:
+        """Earliest *future* time this search needs a step: announce and
+        listen refreshes on the nodes that carry them.  Drives the
+        step job's self-rescheduling so permanent puts and listens are
+        refreshed before their remote expiry even on an otherwise idle
+        node (the reference leaves this to ambient traffic —
+        src/dht.cpp:651-653 commented out — which strands refreshes on
+        quiet networks; newer upstream adds the same scheduling)."""
+        if self.expired or self.done or not self.is_synced(now):
+            return TIME_MAX
+        nxt = TIME_MAX
+        if self.announce:
+            i = 0
+            for sn in self.nodes:
+                if sn.is_bad():
+                    continue
+                for a in self.announce:
+                    t = sn.get_announce_time(a.value.id)
+                    if now < t < nxt:
+                        nxt = t
+                if not sn.candidate:
+                    i += 1
+                    if i == TARGET_NODES:
+                        break
+        if self.listeners:
+            i = 0
+            for sn in self.nodes:
+                if sn.is_bad():
+                    continue
+                for q in list(sn.listen_status):
+                    t = sn.get_listen_time(q)
+                    if now < t < nxt:
+                        nxt = t
+                if not sn.candidate:
+                    i += 1
+                    if i == LISTEN_NODES:
+                        break
+        return nxt
+
     def check_announced(self, vid: int = Value.INVALID_ID) -> None:
         """Fire callbacks of fully-announced values; drop non-permanent
         ones (src/search.h:592-619)."""

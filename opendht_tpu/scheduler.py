@@ -22,12 +22,15 @@ from .utils import TIME_MAX
 
 
 class Job:
-    """A scheduled callable. ``cancel()`` clears it (scheduler.h:41-44)."""
+    """A scheduled callable. ``cancel()`` clears it (scheduler.h:41-44).
+    ``time`` tracks the pending fire time (None once popped/parked) so
+    callers can compare against an intended reschedule."""
 
-    __slots__ = ("func",)
+    __slots__ = ("func", "time")
 
     def __init__(self, func: Optional[Callable[[], None]]):
         self.func = func
+        self.time: Optional[float] = None
 
     def cancel(self) -> None:
         self.func = None
@@ -51,12 +54,14 @@ class Scheduler:
         but is not queued."""
         job = Job(func)
         if t != TIME_MAX:
+            job.time = t
             heapq.heappush(self._heap, (t, next(self._seq), job))
         return job
 
     def queue(self, job: Job, t: float) -> None:
         """Re-enqueue an existing job at ``t`` (scheduler.h:60-63)."""
         if t != TIME_MAX:
+            job.time = t
             heapq.heappush(self._heap, (t, next(self._seq), job))
 
     def edit(self, job: Optional[Job], t: float) -> Optional[Job]:
@@ -67,6 +72,7 @@ class Scheduler:
             return None
         func = job.func
         job.func = None
+        job.time = None
         return self.add(t, func) if func is not None else None
 
     # -- execution ---------------------------------------------------------
@@ -84,6 +90,7 @@ class Scheduler:
         due = []
         while heap and heap[0][0] <= self._now:
             t, _, job = heapq.heappop(heap)
+            job.time = None
             due.append((t, job))
         try:
             while due:
