@@ -123,6 +123,13 @@ class Storage:
             if vs.data is value or vs.data.id == value.id:
                 vs.created = created
                 if vs.data is value:
+                    # same object re-stored: expiration must track the new
+                    # created, or later refresh() calls (which derive the
+                    # ttl from expiration-created) extend by a shrunken ttl
+                    if vs.store_bucket:
+                        vs.store_bucket.erase(key, vs.data, vs.expiration)
+                        vs.store_bucket.insert(key, vs.data, expiration)
+                    vs.expiration = expiration
                     return None, StoreDiff()
                 size_diff = value.size() - vs.data.size()
                 if vs.store_bucket:

@@ -1,0 +1,273 @@
+// Native UDP datagram engine.
+//
+// C++ implementation of the runtime's packet ingress/egress — the role
+// the reference's rcv_thread + NetworkEngine ingress guards play
+// (reference: src/dhtrunner.cpp:511-608 select loop + bounded queue;
+// include/opendht/network_engine.h:424,519-523 global/per-IP rate
+// limits; src/network_engine.cpp:361-386 martian filter).
+//
+// Design: one engine owns a bound UDP socket and a receiver thread that
+// timestamps datagrams into a fixed ring buffer.  Python drains the
+// ring in batches (one ctypes call for many packets) instead of one
+// recvfrom syscall + allocation per packet through the interpreter.
+// Rate limiting and martian filtering run natively before a packet ever
+// reaches Python.
+//
+// C ABI only (ctypes).  Addresses cross the ABI as (ipv4 u32, port u16)
+// pairs — the engine is v4; a v6 twin can reuse the ring/limiter.
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int MAX_PACKET = 1500;
+
+double now_s() {
+    return std::chrono::duration<double>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
+}
+
+// sliding-window quota (reference: include/opendht/rate_limiter.h:26-48)
+struct RateWindow {
+    std::vector<double> hits;
+    size_t quota;
+    double period;
+    RateWindow(size_t q = 0, double p = 1.0) : quota(q), period(p) {}
+    bool limit(double now) {
+        if (quota == 0) return true;           // disabled
+        while (!hits.empty() && hits.front() < now - period)
+            hits.erase(hits.begin());
+        if (hits.size() >= quota) return false;
+        hits.push_back(now);
+        return true;
+    }
+};
+
+struct Packet {
+    double rx_time;
+    uint32_t ip;
+    uint16_t port;
+    uint16_t len;
+    uint8_t data[MAX_PACKET];
+};
+
+struct Engine {
+    int fd = -1;
+    uint16_t bound_port = 0;
+    std::thread rcv;
+    std::atomic<bool> running{false};
+
+    std::vector<Packet> ring;
+    size_t head = 0, tail = 0;                 // ring indices
+    std::mutex mtx;
+    std::condition_variable cv;                // signalled on enqueue
+
+    RateWindow global_limit;
+    std::unordered_map<uint32_t, RateWindow> ip_limits;
+    size_t per_ip_quota = 0;
+    bool drop_martian = true;
+
+    std::atomic<uint64_t> rx_count{0}, dropped_ring{0}, dropped_rate{0},
+        dropped_martian{0}, tx_count{0};
+};
+
+bool is_martian_v4(uint32_t ip_host_order, uint16_t port) {
+    // (network_engine.cpp:361-386): zero port, 0.0.0.0/8, 224/4 multicast,
+    // 127/8 is allowed for localhost operation here (the reference drops
+    // it only on non-local builds)
+    if (port == 0) return true;
+    uint8_t a = ip_host_order >> 24;
+    if (a == 0) return true;
+    if (a >= 224 && a <= 239) return true;
+    return false;
+}
+
+void rcv_loop(Engine* e) {
+    struct pollfd pfd { e->fd, POLLIN, 0 };
+    while (e->running.load(std::memory_order_relaxed)) {
+        int r = poll(&pfd, 1, 100);
+        if (r <= 0) continue;
+        for (;;) {
+            sockaddr_in from{};
+            socklen_t fl = sizeof(from);
+            uint8_t buf[MAX_PACKET];
+            ssize_t n = recvfrom(e->fd, buf, sizeof(buf), MSG_DONTWAIT,
+                                 (sockaddr*)&from, &fl);
+            if (n <= 0) break;
+            double now = now_s();
+            uint32_t ip = ntohl(from.sin_addr.s_addr);
+            uint16_t port = ntohs(from.sin_port);
+            if (e->drop_martian && is_martian_v4(ip, port)) {
+                e->dropped_martian++;
+                continue;
+            }
+            {
+                std::lock_guard<std::mutex> lk(e->mtx);
+                if (!e->global_limit.limit(now)) {
+                    e->dropped_rate++;
+                    continue;
+                }
+                if (e->per_ip_quota) {
+                    // bound the per-IP map: spoofed-source floods must not
+                    // grow memory without limit — evict idle windows once
+                    // the map gets large
+                    if (e->ip_limits.size() > 4096) {
+                        for (auto it = e->ip_limits.begin();
+                             it != e->ip_limits.end();) {
+                            auto& w2 = it->second;
+                            if (w2.hits.empty() ||
+                                w2.hits.back() < now - w2.period)
+                                it = e->ip_limits.erase(it);
+                            else
+                                ++it;
+                        }
+                    }
+                    auto& w = e->ip_limits[ip];
+                    if (w.quota == 0) w = RateWindow(e->per_ip_quota, 1.0);
+                    if (!w.limit(now)) {
+                        e->dropped_rate++;
+                        continue;
+                    }
+                }
+                size_t next = (e->head + 1) % e->ring.size();
+                if (next == e->tail) {         // ring full → drop oldest
+                    e->tail = (e->tail + 1) % e->ring.size();
+                    e->dropped_ring++;
+                }
+                Packet& p = e->ring[e->head];
+                p.rx_time = now;
+                p.ip = ip;
+                p.port = port;
+                p.len = (uint16_t)n;
+                std::memcpy(p.data, buf, n);
+                e->head = next;
+            }
+            e->cv.notify_all();
+            e->rx_count++;
+        }
+    }
+}
+
+} // namespace
+
+extern "C" {
+
+// returns an opaque handle, or null on failure
+void* dht_udp_create(uint16_t port, uint32_t ring_size,
+                     uint32_t global_rps, uint32_t per_ip_rps) {
+    Engine* e = new Engine();
+    e->fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (e->fd < 0) { delete e; return nullptr; }
+    int one = 1;
+    setsockopt(e->fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(port);
+    if (bind(e->fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(e->fd);
+        delete e;
+        return nullptr;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(e->fd, (sockaddr*)&addr, &alen);
+    e->bound_port = ntohs(addr.sin_port);
+    e->ring.resize(ring_size ? ring_size : 16384);
+    // defaults mirror network_engine.h:424 (1600 global, 200 per-IP rps)
+    e->global_limit = RateWindow(global_rps, 1.0);
+    e->per_ip_quota = per_ip_rps;
+    e->running = true;
+    e->rcv = std::thread(rcv_loop, e);
+    return e;
+}
+
+uint16_t dht_udp_port(void* h) { return ((Engine*)h)->bound_port; }
+
+void dht_udp_destroy(void* h) {
+    Engine* e = (Engine*)h;
+    e->running = false;
+    if (e->rcv.joinable()) e->rcv.join();
+    if (e->fd >= 0) close(e->fd);
+    delete e;
+}
+
+int dht_udp_send(void* h, const uint8_t* data, uint32_t len,
+                 uint32_t ip_host_order, uint16_t port) {
+    Engine* e = (Engine*)h;
+    sockaddr_in to{};
+    to.sin_family = AF_INET;
+    to.sin_addr.s_addr = htonl(ip_host_order);
+    to.sin_port = htons(port);
+    ssize_t n = sendto(e->fd, data, len, 0, (sockaddr*)&to, sizeof(to));
+    if (n == (ssize_t)len) { e->tx_count++; return 0; }
+    return errno ? errno : -1;
+}
+
+// Drain up to max_pkts packets.  Layout per packet in out:
+//   f64 rx_time | u32 ip | u16 port | u16 len | u8 data[len]
+// Returns the number of packets written; out_bytes receives bytes used.
+int32_t dht_udp_poll(void* h, uint8_t* out, uint64_t out_cap,
+                     int32_t max_pkts, uint64_t* out_bytes) {
+    Engine* e = (Engine*)h;
+    int32_t count = 0;
+    uint64_t off = 0;
+    std::lock_guard<std::mutex> lk(e->mtx);
+    while (count < max_pkts && e->tail != e->head) {
+        Packet& p = e->ring[e->tail];
+        uint64_t need = 8 + 4 + 2 + 2 + p.len;
+        if (off + need > out_cap) break;
+        std::memcpy(out + off, &p.rx_time, 8); off += 8;
+        std::memcpy(out + off, &p.ip, 4); off += 4;
+        std::memcpy(out + off, &p.port, 2); off += 2;
+        std::memcpy(out + off, &p.len, 2); off += 2;
+        std::memcpy(out + off, p.data, p.len); off += p.len;
+        e->tail = (e->tail + 1) % e->ring.size();
+        ++count;
+    }
+    *out_bytes = off;
+    return count;
+}
+
+// has packets waiting?
+int32_t dht_udp_pending(void* h) {
+    Engine* e = (Engine*)h;
+    std::lock_guard<std::mutex> lk(e->mtx);
+    return e->tail != e->head ? 1 : 0;
+}
+
+// Block until a packet is pending or timeout_ms elapses; returns 1 if
+// pending.  ctypes releases the GIL around the call, so a Python waiter
+// thread can sleep here without starving the interpreter.
+int32_t dht_udp_wait(void* h, int32_t timeout_ms) {
+    Engine* e = (Engine*)h;
+    std::unique_lock<std::mutex> lk(e->mtx);
+    if (e->tail != e->head) return 1;
+    e->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+    return e->tail != e->head ? 1 : 0;
+}
+
+void dht_udp_stats(void* h, uint64_t* out6) {
+    Engine* e = (Engine*)h;
+    out6[0] = e->rx_count.load();
+    out6[1] = e->tx_count.load();
+    out6[2] = e->dropped_ring.load();
+    out6[3] = e->dropped_rate.load();
+    out6[4] = e->dropped_martian.load();
+    std::lock_guard<std::mutex> lk(e->mtx);
+    out6[5] = (e->head + e->ring.size() - e->tail) % e->ring.size();
+}
+
+} // extern "C"

@@ -899,6 +899,19 @@ class Dht:
         if (key, vid) in self._local_refresh_jobs:
             return
 
+        def local_expiration() -> Optional[float]:
+            st = self.store.get(key)
+            if st is not None:
+                for vs in st.values:
+                    if vs.data.id == vid:
+                        return vs.expiration
+            return None
+
+        def arm(at: float) -> None:
+            now = self.scheduler.time()
+            self._local_refresh_jobs[(key, vid)] = self.scheduler.add(
+                max(at, now + 1.0), local_refresh)
+
         def local_refresh():
             still = any(
                 a.permanent and a.value.id == vid
@@ -908,19 +921,25 @@ class Dht:
             if not still:
                 self._local_refresh_jobs.pop((key, vid), None)
                 return
+            now = self.scheduler.time()
             st = self.store.get(key)
-            new_exp = (st.refresh(self.scheduler.time(), vid, key)
+            new_exp = (st.refresh(now, vid, key)
                        if st is not None else None)
+            if new_exp is None:
+                # local copy is gone (swept or evicted) while the
+                # permanent announce lives: re-store it
+                self.storage_store(key, value, now)
+                new_exp = local_expiration()
             if new_exp is not None:
                 self.scheduler.add(new_exp,
                                    lambda: self._expire_storage(key))
-            self._local_refresh_jobs[(key, vid)] = self.scheduler.add(
-                self.scheduler.time() + max(ttl - REANNOUNCE_MARGIN, 1.0),
-                local_refresh)
+                arm(new_exp - REANNOUNCE_MARGIN)
+            else:
+                arm(now + max(ttl - REANNOUNCE_MARGIN, 1.0))
 
-        self._local_refresh_jobs[(key, vid)] = self.scheduler.add(
-            self.scheduler.time() + max(ttl - REANNOUNCE_MARGIN, 1.0),
-            local_refresh)
+        exp = local_expiration()
+        arm((exp - REANNOUNCE_MARGIN) if exp is not None
+            else self.scheduler.time() + max(ttl - REANNOUNCE_MARGIN, 1.0))
 
     def _announce(self, key: InfoHash, af: int, value: Value, callback,
                   created: Optional[float], permanent: bool) -> None:

@@ -56,12 +56,15 @@ class RunnerConfig:
     def __init__(self, dht_config: Optional[Config] = None,
                  identity: "crypto.Identity | None" = None,
                  threaded: bool = True, proxy_server: str = "",
-                 push_node_id: str = ""):
+                 push_node_id: str = "", native_engine: bool = True):
         self.dht_config = dht_config or Config()
         self.identity = identity
         self.threaded = threaded
         self.proxy_server = proxy_server
         self.push_node_id = push_node_id
+        #: use the C++ datagram engine (ring buffer + native ingress
+        #: guards, opendht_tpu/native) for IPv4 when it is available
+        self.native_engine = native_engine
 
 
 class DhtRunner:
@@ -71,6 +74,9 @@ class DhtRunner:
         self._dht: Optional[SecureDht] = None
         self._sock4: Optional[_socket.socket] = None
         self._sock6: Optional[_socket.socket] = None
+        self._udp = None                       # native UdpEngine (IPv4)
+        self._native_thread: Optional[threading.Thread] = None
+        self._net_running = False
         self._stop_rd, self._stop_wr = None, None
         self.running = False
         self.bound_port = 0
@@ -132,11 +138,29 @@ class DhtRunner:
         self._dht_thread.start()
 
     def _start_network(self, port: int, ipv6: bool) -> None:
-        """(↔ DhtRunner::startNetwork, dhtrunner.cpp:511-608)"""
-        self._sock4 = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-        self._sock4.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
-        self._sock4.bind(("0.0.0.0", port))
-        self.bound_port = self._sock4.getsockname()[1]
+        """(↔ DhtRunner::startNetwork, dhtrunner.cpp:511-608).  IPv4 goes
+        through the native C++ datagram engine when available (recv
+        thread, ring buffer, martian filter and rate limits run in C++;
+        Python drains packet batches) and falls back to a Python socket
+        otherwise."""
+        self._net_running = True
+        if self._config.native_engine:
+            try:
+                from ..native import UdpEngine, available
+                if available():
+                    self._udp = UdpEngine(port)
+                    self.bound_port = self._udp.port
+                    self._native_thread = threading.Thread(
+                        target=self._native_rcv_loop, name="dht-rcv-native",
+                        daemon=True)
+            except (OSError, RuntimeError, ImportError):
+                self._udp = None
+        if self._udp is None:
+            self._sock4 = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            self._sock4.setsockopt(_socket.SOL_SOCKET,
+                                   _socket.SO_REUSEADDR, 1)
+            self._sock4.bind(("0.0.0.0", port))
+            self.bound_port = self._sock4.getsockname()[1]
         if ipv6:
             try:
                 self._sock6 = _socket.socket(_socket.AF_INET6,
@@ -147,11 +171,19 @@ class DhtRunner:
             except OSError:
                 self._sock6 = None
         self._stop_rd, self._stop_wr = os.pipe()
-        self._rcv_thread = threading.Thread(
-            target=self._rcv_loop, name="dht-rcv", daemon=True)
-        self._rcv_thread.start()
+        if self._sock4 is not None or self._sock6 is not None:
+            self._rcv_thread = threading.Thread(
+                target=self._rcv_loop, name="dht-rcv", daemon=True)
+            self._rcv_thread.start()
+        if self._native_thread is not None:
+            self._native_thread.start()
 
     def _send(self, data: bytes, dest: SockAddr) -> int:
+        if dest.family != _socket.AF_INET6 and self._udp is not None:
+            try:
+                return self._udp.send(data, dest.to_tuple())
+            except OSError as e:
+                return e.errno or 1
         sock = self._sock6 if dest.family == _socket.AF_INET6 else self._sock4
         if sock is None:
             return 1
@@ -160,6 +192,35 @@ class DhtRunner:
             return 0
         except OSError as e:
             return e.errno or 1
+
+    # --------------------------------------------------- native rcv thread
+    def _native_rcv_loop(self) -> None:
+        """Drain the C++ engine's ring into the runner queue; the wait
+        blocks in C++ (GIL released) until packets arrive."""
+        udp = self._udp
+        while self._net_running:
+            try:
+                if not udp.wait(0.1):
+                    continue
+                pkts = udp.poll(256)
+            except Exception:
+                if not self._net_running:
+                    break
+                log.exception("native rcv error; retrying")
+                _time.sleep(0.1)
+                continue
+            if not pkts:
+                continue
+            # timestamp with the Python clock: the staleness check in
+            # _loop compares against time.monotonic(), and the C++
+            # steady_clock epoch is not guaranteed to match it
+            now = _time.monotonic()
+            with self._sock_lock:
+                for _rx_time, data, (host, port) in pkts:
+                    if len(self._rcv) < RX_QUEUE_MAX_SIZE:
+                        self._rcv.append((now, data, SockAddr(host, port)))
+            with self._cv:
+                self._cv.notify()
 
     # ------------------------------------------------------------ rcv thread
     def _rcv_loop(self) -> None:
@@ -599,6 +660,7 @@ class DhtRunner:
         """Stop threads, close sockets (↔ DhtRunner::join,
         dhtrunner.cpp:151-195)."""
         self.running = False
+        self._net_running = False
         with self._cv:
             self._cv.notify_all()
         with self._bootstrap_cv:
@@ -609,13 +671,17 @@ class DhtRunner:
             except OSError:
                 pass
         for t in (self._dht_thread, self._rcv_thread,
-                  self._bootstrap_thread):
+                  self._native_thread, self._bootstrap_thread):
             if t is not None and t.is_alive():
                 t.join(timeout=5.0)
         for sock in (self._sock4, self._sock6):
             if sock is not None:
                 sock.close()
         self._sock4 = self._sock6 = None
+        if self._udp is not None:
+            self._udp.close()
+            self._udp = None
+        self._native_thread = None
         if self._stop_rd is not None:
             os.close(self._stop_rd)
             os.close(self._stop_wr)

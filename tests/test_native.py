@@ -1,0 +1,125 @@
+"""Native C++ engine tests: scalar-kernel parity with the Python
+InfoHash reference, sorted-walk vs full-scan agreement, and the UDP
+engine's loopback datagram path + ingress guards."""
+
+import time
+
+import numpy as np
+import pytest
+
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _rand_ids(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, 20), dtype=np.uint8)
+
+
+# ------------------------------------------------------------ scalar parity
+
+def test_xor_cmp_matches_python():
+    ids = _rand_ids(64, 1)
+    s = InfoHash(bytes(ids[0]))
+    for i in range(1, 31, 3):
+        a, b = InfoHash(bytes(ids[i])), InfoHash(bytes(ids[i + 1]))
+        assert native.xor_cmp(bytes(s), bytes(a), bytes(b)) == \
+            s.xor_cmp(a, b)
+    assert native.xor_cmp(bytes(s), bytes(ids[5]), bytes(ids[5])) == 0
+
+
+def test_common_bits_matches_python():
+    ids = _rand_ids(32, 2)
+    for i in range(0, 30, 2):
+        a, b = InfoHash(bytes(ids[i])), InfoHash(bytes(ids[i + 1]))
+        assert native.common_bits(bytes(a), bytes(b)) == \
+            InfoHash.common_bits(a, b)
+    a = InfoHash(bytes(ids[0]))
+    assert native.common_bits(bytes(a), bytes(a)) == 160
+
+
+# ------------------------------------------------------------- table lookup
+
+def test_sorted_walk_equals_full_scan():
+    ids = _rand_ids(500, 3)
+    queries = _rand_ids(40, 4)
+    sorted_ids, perm = native.sort_ids(ids)
+    walk = native.sorted_closest(sorted_ids, queries, k=8, window=64)
+    scan = native.scan_closest(ids, queries, k=8)
+    # map walk's sorted indices back to original rows
+    walk_rows = np.where(walk >= 0, perm[np.clip(walk, 0, None)], -1)
+    assert np.array_equal(walk_rows, scan)
+
+
+def test_sorted_walk_matches_device_kernel():
+    """Native outward walk == JAX full-scan oracle (ops/xor_topk)."""
+    import jax.numpy as jnp
+    from opendht_tpu.ops.ids import ids_from_bytes
+    from opendht_tpu.ops.xor_topk import xor_topk
+
+    ids = _rand_ids(300, 5)
+    queries = _rand_ids(17, 6)
+    sorted_ids, perm = native.sort_ids(ids)
+    walk = native.sorted_closest(sorted_ids, queries, k=8)
+    walk_rows = np.where(walk >= 0, perm[np.clip(walk, 0, None)], -1)
+
+    _, idx = xor_topk(jnp.asarray(ids_from_bytes(queries)),
+                      jnp.asarray(ids_from_bytes(ids)), k=8)
+    assert np.array_equal(walk_rows, np.asarray(idx))
+
+
+def test_small_table_padding():
+    ids = _rand_ids(3, 7)
+    queries = _rand_ids(2, 8)
+    sorted_ids, perm = native.sort_ids(ids)
+    out = native.sorted_closest(sorted_ids, queries, k=8)
+    assert (out[:, :3] >= 0).all() and (out[:, 3:] == -1).all()
+
+
+# --------------------------------------------------------------- UDP engine
+
+def test_udp_loopback_roundtrip():
+    with native.UdpEngine(0) as a, native.UdpEngine(0) as b:
+        assert a.port > 0 and b.port > 0
+        assert a.send(b"ping-payload", ("127.0.0.1", b.port)) == 0
+        deadline = time.monotonic() + 5.0
+        pkts = []
+        while not pkts and time.monotonic() < deadline:
+            pkts = b.poll()
+            time.sleep(0.01)
+        assert pkts, "packet never arrived"
+        rx_time, data, (host, port) = pkts[0]
+        assert data == b"ping-payload"
+        assert host == "127.0.0.1" and port == a.port
+        assert rx_time > 0
+        st = b.stats()
+        assert st["rx"] == 1 and st["queued"] == 0
+
+
+def test_udp_rate_limit_drops():
+    with native.UdpEngine(0) as a, \
+            native.UdpEngine(0, per_ip_rps=10, global_rps=10) as b:
+        for i in range(50):
+            a.send(b"x%d" % i, ("127.0.0.1", b.port))
+        time.sleep(0.5)
+        got = len(b.poll(max_pkts=100))
+        st = b.stats()
+        assert got <= 10
+        assert st["dropped_rate"] >= 30
+
+
+def test_udp_batch_poll():
+    with native.UdpEngine(0) as a, native.UdpEngine(0) as b:
+        for i in range(20):
+            a.send(("msg-%02d" % i).encode(), ("127.0.0.1", b.port))
+        deadline = time.monotonic() + 5.0
+        got = []
+        while len(got) < 20 and time.monotonic() < deadline:
+            got.extend(b.poll(max_pkts=64))
+            time.sleep(0.01)
+        assert len(got) == 20
+        assert [p[1] for p in got] == \
+            [("msg-%02d" % i).encode() for i in range(20)]
