@@ -9,16 +9,19 @@ node-ID matrices instead of scalar per-search loops.
 
 Package layout (mirrors the reference's layer map, SURVEY.md §1):
 
-- ``ops``        L0 device kernels: 160-bit ID math, XOR top-k, radix partition
+- ``ops``        L0 device kernels: 160-bit ID math, XOR top-k (lax + pallas),
+                 sorted-table window lookup, radix partition
 - ``core``       L2 data structures: node table, routing, batched search, storage, values
-- ``net``        L1 host network engine: msgpack wire protocol over asyncio UDP
+- ``net``        L1 host network engine: msgpack wire protocol, request lifecycle
+- ``native``     C++ host runtime: XOR engine + UDP datagram engine (ctypes)
 - ``crypto``     L0/L3 identities, sign/encrypt (SecureDht overlay)
 - ``runtime``    L4 Dht core + DhtRunner façade + scheduler
 - ``parallel``   multi-chip sharded tables (jax.sharding Mesh + shard_map)
 - ``proxy``      REST proxy server/client
 - ``indexation`` PHT (prefix hash tree) distributed index
 - ``tools``      dhtnode / dhtchat / dhtscanner CLI equivalents
-- ``sim``        in-process cluster + device-level lookup simulators
+- ``testing``    cluster harness: virtual-clock network, scenario suites, benchmark
+- ``log``        Logger with per-hash filter and console/file/syslog sinks
 """
 
 __version__ = "0.1.0"

@@ -78,6 +78,7 @@ struct Engine {
     RateWindow global_limit;
     std::unordered_map<uint32_t, RateWindow> ip_limits;
     size_t per_ip_quota = 0;
+    double last_prune = 0.0;
     bool drop_martian = true;
 
     std::atomic<uint64_t> rx_count{0}, dropped_ring{0}, dropped_rate{0},
@@ -123,8 +124,11 @@ void rcv_loop(Engine* e) {
                 if (e->per_ip_quota) {
                     // bound the per-IP map: spoofed-source floods must not
                     // grow memory without limit — evict idle windows once
-                    // the map gets large
-                    if (e->ip_limits.size() > 4096) {
+                    // the map gets large, at most once per second (an O(n)
+                    // sweep per packet would itself be the DoS)
+                    if (e->ip_limits.size() > 4096 &&
+                        now - e->last_prune > 1.0) {
+                        e->last_prune = now;
                         for (auto it = e->ip_limits.begin();
                              it != e->ip_limits.end();) {
                             auto& w2 = it->second;

@@ -81,6 +81,10 @@ void dht_sort_ids(uint8_t* ids, int32_t* perm, int64_t n) {
     delete[] tmp;
 }
 
+void dht_scan_closest(const uint8_t* ids, int64_t n,
+                      const uint8_t* queries, int64_t nq,
+                      int32_t k, int32_t* out);
+
 // First index i in [0,n) with sorted_ids[i] >= q (lower bound).
 int64_t dht_lower_bound(const uint8_t* sorted_ids, int64_t n,
                         const uint8_t* q) {
@@ -138,7 +142,29 @@ void dht_sorted_closest(const uint8_t* sorted_ids, int64_t n,
                 if (got < k) ++got;
             }
         }
-        for (; got < k; ++got) row[got] = -1;
+        for (int32_t g = got; g < k; ++g) row[g] = -1;
+
+        // exactness certificate (same argument as the device kernel,
+        // ops/sorted_table.py:134-157): excluded nodes sit beyond the
+        // window's edges; the kth result beats them all iff it shares a
+        // strictly longer prefix with q than the nearest excluded
+        // neighbor on each unexhausted side.  On failure, fall back to
+        // the exact full scan for this query.
+        bool certified = true;
+        if (got == k) {
+            int cp_k = common_bits(q, sorted_ids +
+                                   (int64_t)row[k - 1] * HASH_LEN);
+            if (lo >= 0 &&
+                cp_k <= common_bits(q, sorted_ids + lo * HASH_LEN))
+                certified = false;
+            if (hi < n &&
+                cp_k <= common_bits(q, sorted_ids + hi * HASH_LEN))
+                certified = false;
+        } else if (lo >= 0 || hi < n) {
+            certified = false;   // fewer than k found but rows excluded
+        }
+        if (!certified)
+            dht_scan_closest(sorted_ids, n, q, 1, k, row);
     }
 }
 

@@ -430,7 +430,8 @@ class Dht:
 
         if sr.get_number_of_consecutive_bad_nodes() >= min(
                 len(sr.nodes), SEARCH_MAX_BAD_NODES):
-            log.warning("[search %s] expired", sr.id)
+            log.warning("[search %s] expired", sr.id,
+                        extra={"dht_hash": bytes(sr.id)})
             sr.expire()
             self.connectivity_changed(sr.af)
             return

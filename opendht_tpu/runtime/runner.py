@@ -148,7 +148,17 @@ class DhtRunner:
             try:
                 from ..native import UdpEngine, available
                 if available():
-                    self._udp = UdpEngine(port)
+                    # The native limits are a datagram-level flood
+                    # backstop only: the protocol-level request limiting
+                    # (requests-only, configurable) stays in the Python
+                    # engine (net/engine.py:335).  Give the backstop 8×
+                    # headroom over the request budget so responses and
+                    # localhost clusters (many nodes sharing one source
+                    # IP) are never throttled natively.
+                    budget = self._config.dht_config.max_req_per_sec
+                    self._udp = UdpEngine(port,
+                                          global_rps=max(budget, 8) * 8,
+                                          per_ip_rps=0)
                     self.bound_port = self._udp.port
                     self._native_thread = threading.Thread(
                         target=self._native_rcv_loop, name="dht-rcv-native",

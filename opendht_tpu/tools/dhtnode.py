@@ -196,6 +196,30 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                                done_cb=lambda ok: done.append(ok))
                     _wait(done)
                     print("Lookup: %s" % (done and done[0]))
+            elif op == "log":
+                # toggle / route logging (↔ dhtnode.cpp:87-96)
+                from ..log import DhtLogger
+                if not hasattr(node, "_cli_logger"):
+                    node._cli_logger = DhtLogger()
+                lg = node._cli_logger
+                arg = rest[0] if rest else "on"
+                if arg == "off":
+                    lg.disable()
+                    print("logging off")
+                elif arg == "file":
+                    lg.set_sink_file(rest[1])
+                    print("logging to %s" % rest[1])
+                elif arg == "syslog":
+                    lg.set_sink_syslog()
+                    print("logging to syslog")
+                elif len(arg) == 2 * InfoHash.HASH_LEN:
+                    lg.set_filter(InfoHash(arg))
+                    lg.set_sink_console()
+                    print("logging filtered to %s" % arg)
+                else:
+                    lg.set_filter(None)
+                    lg.set_sink_console()
+                    print("logging on")
             elif op == "stt":
                 from ..proxy import DhtProxyServer
                 if proxy_server is not None:

@@ -1,0 +1,68 @@
+"""Logger subsystem tests (↔ reference log_enable.h per-hash filter and
+sink plumbing)."""
+
+import logging
+
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.log import DhtLogger
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+def _capturing_logger(name):
+    lg = DhtLogger(name)
+    cap = _Capture()
+    lg._swap_handler(cap)
+    return lg, cap
+
+
+def test_disable_restores_logger_state():
+    import logging as _l
+    name = "t.restore"
+    base = _l.getLogger(name)
+    base.setLevel(_l.WARNING)
+    lg = DhtLogger(name)
+    assert base.level == _l.WARNING          # construction mutates nothing
+    lg.set_sink_file("/dev/null")
+    assert base.level == _l.DEBUG and not base.propagate
+    lg.disable()
+    assert base.level == _l.WARNING and base.propagate
+
+
+def test_streams_reach_sink():
+    lg, cap = _capturing_logger("t.streams")
+    lg.e("err %d", 1)
+    lg.w("warn %s", "x")
+    lg.d("dbg")
+    assert cap.lines == ["err 1", "warn x", "dbg"]
+
+
+def test_per_hash_filter():
+    lg, cap = _capturing_logger("t.filter")
+    h1, h2 = InfoHash.get("one"), InfoHash.get("two")
+    lg.set_filter(h1)
+    lg.d("about one", h=h1)
+    lg.d("about two", h=h2)
+    lg.d("untagged")
+    assert cap.lines == ["about one"]
+    lg.set_filter(None)
+    lg.d("untagged 2")
+    assert cap.lines == ["about one", "untagged 2"]
+
+
+def test_file_sink(tmp_path):
+    lg = DhtLogger("t.file")
+    path = str(tmp_path / "dht.log")
+    lg.set_sink_file(path)
+    lg.w("to the file")
+    lg.disable()
+    with open(path) as f:
+        content = f.read()
+    assert "to the file" in content and "WARN" in content
