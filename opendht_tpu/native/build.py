@@ -81,7 +81,8 @@ def _declare(lib: ctypes.CDLL) -> None:
                                      ctypes.c_int64, ctypes.c_int32, i32p]
     lib.dht_udp_create.restype = ctypes.c_void_p
     lib.dht_udp_create.argtypes = [ctypes.c_uint16, ctypes.c_uint32,
-                                   ctypes.c_uint32, ctypes.c_uint32]
+                                   ctypes.c_uint32, ctypes.c_uint32,
+                                   ctypes.c_int32]
     lib.dht_udp_port.restype = ctypes.c_uint16
     lib.dht_udp_port.argtypes = [ctypes.c_void_p]
     lib.dht_udp_destroy.restype = None

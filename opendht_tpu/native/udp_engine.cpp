@@ -80,6 +80,7 @@ struct Engine {
     size_t per_ip_quota = 0;
     double last_prune = 0.0;
     bool drop_martian = true;
+    bool exempt_loopback = true;
 
     std::atomic<uint64_t> rx_count{0}, dropped_ring{0}, dropped_rate{0},
         dropped_martian{0}, tx_count{0};
@@ -115,13 +116,17 @@ void rcv_loop(Engine* e) {
                 e->dropped_martian++;
                 continue;
             }
+            // loopback traffic is exempt from rate limiting: local
+            // clusters legitimately share 127.0.0.1 as the source, and
+            // the limits exist for remote floods
+            bool loopback = e->exempt_loopback && (ip >> 24) == 127;
             {
                 std::lock_guard<std::mutex> lk(e->mtx);
-                if (!e->global_limit.limit(now)) {
+                if (!loopback && !e->global_limit.limit(now)) {
                     e->dropped_rate++;
                     continue;
                 }
-                if (e->per_ip_quota) {
+                if (!loopback && e->per_ip_quota) {
                     // bound the per-IP map: spoofed-source floods must not
                     // grow memory without limit — evict idle windows once
                     // the map gets large, at most once per second (an O(n)
@@ -171,8 +176,10 @@ extern "C" {
 
 // returns an opaque handle, or null on failure
 void* dht_udp_create(uint16_t port, uint32_t ring_size,
-                     uint32_t global_rps, uint32_t per_ip_rps) {
+                     uint32_t global_rps, uint32_t per_ip_rps,
+                     int32_t exempt_loopback) {
     Engine* e = new Engine();
+    e->exempt_loopback = exempt_loopback != 0;
     e->fd = socket(AF_INET, SOCK_DGRAM, 0);
     if (e->fd < 0) { delete e; return nullptr; }
     int one = 1;

@@ -92,12 +92,14 @@ class UdpEngine:
     _HDR = struct.Struct("<dIHH")
 
     def __init__(self, port: int = 0, *, ring_size: int = 16384,
-                 global_rps: int = 1600, per_ip_rps: int = 200):
+                 global_rps: int = 1600, per_ip_rps: int = 200,
+                 exempt_loopback: bool = True):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib
-        self._h = lib.dht_udp_create(port, ring_size, global_rps, per_ip_rps)
+        self._h = lib.dht_udp_create(port, ring_size, global_rps, per_ip_rps,
+                                     1 if exempt_loopback else 0)
         if not self._h:
             raise OSError("could not bind UDP port %d" % port)
         self.port = lib.dht_udp_port(self._h)

@@ -342,8 +342,9 @@ class Dht:
                      if (s.done or s.expired) and not s.announce
                      and not s.listeners), None)
                 if victim is None:
-                    log.error("[search %s] maximum number of searches reached",
-                              target)
+                    log.error("[search %s] maximum number of searches "
+                              "reached", target,
+                              extra={"dht_hash": bytes(target)})
                     if done_cb:
                         done_cb(False, [])
                     return None
@@ -748,6 +749,7 @@ class Dht:
             f: Optional[Filter] = None, where: Optional[Where] = None) -> None:
         """Iterative value lookup over both families
         (↔ Dht::get, src/dht.cpp:980-1017)."""
+        log.debug("[search %s] get", key, extra={"dht_hash": bytes(key)})
         q = Query(Select(), where or Where())
         f = Filters.chain(f, q.where.get_filter())
         # done when the user stops us or both family searches finish;
@@ -990,6 +992,7 @@ class Dht:
                where: Optional[Where] = None) -> int:
         """Subscribe to values under a key (↔ Dht::listen,
         src/dht.cpp:827-867).  Returns a token for cancel_listen."""
+        log.debug("[search %s] listen", key, extra={"dht_hash": bytes(key)})
         q = Query(Select(), where or Where())
         self._listener_token += 1
         token = self._listener_token
@@ -1083,6 +1086,8 @@ class Dht:
     def storage_store(self, key: InfoHash, value: Value, created: float,
                       sa: Optional[SockAddr] = None) -> bool:
         """(↔ Dht::storageStore, src/dht.cpp:1193-1228)"""
+        log.debug("[store %s] storing value %x", key, value.id,
+                  extra={"dht_hash": bytes(key)})
         now = self.scheduler.time()
         created = min(created, now)
         expiration = created + self.types.get_type(value.type).expiration
@@ -1335,7 +1340,8 @@ class Dht:
             if len(rows) >= TARGET_NODES:
                 kth = table.id_of(int(rows[-1]))
                 if key.xor_cmp(kth, self.myid) < 0:
-                    log.debug("[store %s] announce too far from target", key)
+                    log.debug("[store %s] announce too far from target", key,
+                          extra={"dht_hash": bytes(key)})
                     return RequestAnswer()
         now = self.scheduler.time()
         created = min(created, now) if created is not None else now

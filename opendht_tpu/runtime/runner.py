@@ -152,13 +152,14 @@ class DhtRunner:
                     # backstop only: the protocol-level request limiting
                     # (requests-only, configurable) stays in the Python
                     # engine (net/engine.py:335).  Give the backstop 8×
-                    # headroom over the request budget so responses and
-                    # localhost clusters (many nodes sharing one source
-                    # IP) are never throttled natively.
-                    budget = self._config.dht_config.max_req_per_sec
+                    # headroom over the request budget so responses are
+                    # never throttled natively; loopback sources are
+                    # exempt in the engine itself, so localhost clusters
+                    # sharing 127.0.0.1 are unaffected.
+                    budget = max(self._config.dht_config.max_req_per_sec, 8)
                     self._udp = UdpEngine(port,
-                                          global_rps=max(budget, 8) * 8,
-                                          per_ip_rps=0)
+                                          global_rps=budget * 8,
+                                          per_ip_rps=budget)
                     self.bound_port = self._udp.port
                     self._native_thread = threading.Thread(
                         target=self._native_rcv_loop, name="dht-rcv-native",

@@ -75,7 +75,6 @@ def test_clustered_table_certificate_fallback():
     """Adversarially clustered ids (hundreds sharing a prefix) defeat a
     fixed window; the native certificate must trigger the full-scan
     fallback so results stay exact even with a tiny window."""
-    rng = np.random.default_rng(9)
     ids = _rand_ids(300, 9)
     ids[:200, :6] = 0xAB                 # 200 ids share a 48-bit prefix
     queries = _rand_ids(25, 10)
@@ -124,7 +123,8 @@ def test_udp_loopback_roundtrip():
 
 def test_udp_rate_limit_drops():
     with native.UdpEngine(0) as a, \
-            native.UdpEngine(0, per_ip_rps=10, global_rps=10) as b:
+            native.UdpEngine(0, per_ip_rps=10, global_rps=10,
+                             exempt_loopback=False) as b:
         for i in range(50):
             a.send(b"x%d" % i, ("127.0.0.1", b.port))
         time.sleep(0.5)
@@ -132,6 +132,22 @@ def test_udp_rate_limit_drops():
         st = b.stats()
         assert got <= 10
         assert st["dropped_rate"] >= 30
+
+
+def test_udp_loopback_exempt_from_limits():
+    """Default engines never rate-limit 127.0.0.1 sources (local
+    clusters share that IP)."""
+    with native.UdpEngine(0) as a, \
+            native.UdpEngine(0, per_ip_rps=5, global_rps=5) as b:
+        for i in range(40):
+            a.send(b"y%d" % i, ("127.0.0.1", b.port))
+        deadline = time.monotonic() + 5.0
+        got = []
+        while len(got) < 40 and time.monotonic() < deadline:
+            got.extend(b.poll(max_pkts=64))
+            time.sleep(0.01)
+        assert len(got) == 40
+        assert b.stats()["dropped_rate"] == 0
 
 
 def test_udp_batch_poll():
