@@ -56,7 +56,8 @@ class RunnerConfig:
     def __init__(self, dht_config: Optional[Config] = None,
                  identity: "crypto.Identity | None" = None,
                  threaded: bool = True, proxy_server: str = "",
-                 push_node_id: str = "", native_engine: bool = True):
+                 push_node_id: str = "", native_engine: bool = True,
+                 native_exempt_loopback: bool = True):
         self.dht_config = dht_config or Config()
         self.identity = identity
         self.threaded = threaded
@@ -65,6 +66,9 @@ class RunnerConfig:
         #: use the C++ datagram engine (ring buffer + native ingress
         #: guards, opendht_tpu/native) for IPv4 when it is available
         self.native_engine = native_engine
+        #: skip native rate limits for 127/8 sources (local clusters);
+        #: disable on hosts where loopback spoofing is a concern
+        self.native_exempt_loopback = native_exempt_loopback
 
 
 class DhtRunner:
@@ -151,15 +155,16 @@ class DhtRunner:
                     # The native limits are a datagram-level flood
                     # backstop only: the protocol-level request limiting
                     # (requests-only, configurable) stays in the Python
-                    # engine (net/engine.py:335).  Give the backstop 8×
-                    # headroom over the request budget so responses are
-                    # never throttled natively; loopback sources are
-                    # exempt in the engine itself, so localhost clusters
-                    # sharing 127.0.0.1 are unaffected.
+                    # engine (net/engine.py:335).  Both limits get 8×
+                    # headroom over the request budget so responses (and
+                    # NATed clusters sharing one source IP) are never
+                    # throttled natively; loopback exemption is a config
+                    # knob (default on for local clusters).
                     budget = max(self._config.dht_config.max_req_per_sec, 8)
-                    self._udp = UdpEngine(port,
-                                          global_rps=budget * 8,
-                                          per_ip_rps=budget)
+                    self._udp = UdpEngine(
+                        port, global_rps=budget * 8,
+                        per_ip_rps=budget * 8,
+                        exempt_loopback=self._config.native_exempt_loopback)
                     self.bound_port = self._udp.port
                     self._native_thread = threading.Thread(
                         target=self._native_rcv_loop, name="dht-rcv-native",
