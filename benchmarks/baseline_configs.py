@@ -11,7 +11,7 @@ Configs (BASELINE.json):
   1 dhtnode single-process: 1K get() lookups over a 10K-node routing
     table — CPU reference (the native C++ sorted walk) vs the device
     batched lookup.
-  2 batched findClosestNodes: 100K queries × 1M ids, top-16 (the
+  2 batched findClosestNodes: 131K queries × 1M ids, top-16 (the
     headline bench, see bench.py).
   3 iterative Search simulation: α-parallel lookups vs a 10M-node
     simulated network, k=8 convergence, hop counts.
@@ -72,13 +72,8 @@ def config1() -> dict:
         t_bytes = ids_to_bytes(np.asarray(sorted_ids)).reshape(N, 20)
         q_bytes = ids_to_bytes(queries).reshape(Q, 20)
         # same warm + best-of-N treatment as the device path
-        for _ in range(2):
-            native.sorted_closest(t_bytes, q_bytes, k=K)
-        for _ in range(5):
-            t0 = time.perf_counter()
-            native.sorted_closest(t_bytes, q_bytes, k=K)
-            dt = time.perf_counter() - t0
-            baseline = dt if baseline is None else min(baseline, dt)
+        baseline = _rates(
+            lambda: native.sorted_closest(t_bytes, q_bytes, k=K))
     return {"metric": "config1 1K get() over 10K-node table",
             "value": round(Q / dt_dev, 1), "unit": "lookups/s",
             "vs_baseline": round((Q / dt_dev) / (Q / baseline), 2)

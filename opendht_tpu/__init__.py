@@ -32,5 +32,16 @@ from .runtime.config import Config, NodeStats, NodeStatus, SecureDhtConfig  # no
 from .runtime.runner import DhtRunner, RunnerConfig  # noqa: F401
 from .crypto import (  # noqa: F401
     Certificate, Identity, PrivateKey, PublicKey, RevocationList, TrustList,
-    generate_identity, generate_ec_identity,
+    VerifyResult, generate_identity, generate_ec_identity,
 )
+from .sockaddr import SockAddr  # noqa: F401
+from .net.node import Node  # noqa: F401
+from .nodeset import NodeEntry, NodeSet  # noqa: F401
+from .indexation.pht import IndexEntry as IndexValue, Pht  # noqa: F401
+
+#: binding-compat aliases (↔ python/opendht.pyx names)
+DhtConfig = Config
+#: DhtRunner.listen returns this token handle (a Future resolving to the
+#: runner-level token — pass it back to cancel_listen)
+import concurrent.futures as _futures
+ListenToken = _futures.Future

@@ -155,14 +155,15 @@ class DhtRunner:
                     # The native limits are a datagram-level flood
                     # backstop only: the protocol-level request limiting
                     # (requests-only, configurable) stays in the Python
-                    # engine (net/engine.py:335).  Both limits get 8×
-                    # headroom over the request budget so responses (and
-                    # NATed clusters sharing one source IP) are never
-                    # throttled natively; loopback exemption is a config
-                    # knob (default on for local clusters).
+                    # engine (net/engine.py:335).  Per-IP gets 8×
+                    # headroom over the request budget (responses, NATed
+                    # clusters) while global sits another 2× above it so
+                    # one flooding source can never consume the whole
+                    # global window; loopback exemption is a config knob
+                    # (default on for local clusters).
                     budget = max(self._config.dht_config.max_req_per_sec, 8)
                     self._udp = UdpEngine(
-                        port, global_rps=budget * 8,
+                        port, global_rps=budget * 16,
                         per_ip_rps=budget * 8,
                         exempt_loopback=self._config.native_exempt_loopback)
                     self.bound_port = self._udp.port

@@ -1,0 +1,31 @@
+"""Python API surface parity with the reference binding
+(python/opendht.pyx class list) plus NodeSet behavior."""
+
+import opendht_tpu as o
+
+
+PYX_SURFACE = [
+    "Certificate", "DhtConfig", "DhtRunner", "Identity", "IndexValue",
+    "InfoHash", "ListenToken", "Node", "NodeEntry", "NodeSet", "Pht",
+    "PrivateKey", "PublicKey", "Query", "Select", "SockAddr", "TrustList",
+    "Value", "VerifyResult", "Where",
+]
+
+
+def test_pyx_class_surface_present():
+    missing = [n for n in PYX_SURFACE if not hasattr(o, n)]
+    assert not missing, missing
+
+
+def test_nodeset_sorted_semantics():
+    ns = o.NodeSet()
+    ids = [o.InfoHash.get(s) for s in ("x", "y", "z")]
+    assert ns.insert(ids[1])
+    assert not ns.insert(ids[1])            # duplicate: map semantics
+    ns.extend([(ids[0], None), o.NodeEntry(ids[2])])
+    assert len(ns) == 3
+    ordered = [e.id for e in ns]
+    assert ordered == sorted(ids, key=bytes)
+    assert ns.first() == ordered[0] and ns.last() == ordered[-1]
+    assert ids[0] in ns
+    assert str(ns).count("\n") == 2

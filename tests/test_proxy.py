@@ -136,6 +136,33 @@ def test_stats_endpoint(topology):
     assert "putCount" in st and "listenCount" in st and "nodeInfo" in st
 
 
+def test_subscribe_push_notifications(topology):
+    """SUBSCRIBE registers a push listener; value arrivals invoke the
+    server's push sender (the reference POSTs to Gorush,
+    dht_proxy_server.cpp:411-469); UNSUBSCRIBE stops it."""
+    peer, proxy_node, server, client = topology
+    pushed = []
+    server._push_sender = lambda client_id, payload: pushed.append(
+        (client_id, payload))
+    try:
+        push_client = DhtProxyClient("127.0.0.1", server.port,
+                                     client_id="device-42")
+        key = InfoHash.get("push-key")
+        res = push_client.subscribe(key)
+        assert res is not None and "token" in res
+        time.sleep(1.0)
+        assert peer.put_sync(key, Value(b"push-me", value_id=61),
+                             timeout=20.0)
+        assert wait_for(lambda: any(cid == "device-42" and
+                                    61 in p.get("ids", [])
+                                    for cid, p in pushed), timeout=25.0), \
+            pushed
+        assert push_client.unsubscribe(key).get("ok") is True
+        push_client.join()
+    finally:
+        server._push_sender = None
+
+
 def test_runner_enable_proxy_hotswap(topology):
     """A third runner switches its backend to the REST proxy, ops and the
     live listener carry over, then it swaps back (dhtrunner.cpp:992-1041,
