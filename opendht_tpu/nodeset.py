@@ -66,12 +66,12 @@ class NodeSet:
     def first(self) -> InfoHash:
         if not self._nodes:
             raise IndexError("empty NodeSet")
-        return self._sorted()[0].id
+        return self._nodes[min(self._nodes)].id
 
     def last(self) -> InfoHash:
         if not self._nodes:
             raise IndexError("empty NodeSet")
-        return self._sorted()[-1].id
+        return self._nodes[max(self._nodes)].id
 
     def _sorted(self) -> list:
         return [self._nodes[k] for k in sorted(self._nodes)]

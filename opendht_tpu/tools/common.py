@@ -108,6 +108,40 @@ def setup_node(args) -> DhtRunner:
     return node
 
 
+def save_state(node: DhtRunner, path: str) -> None:
+    """Persist good nodes + stored values to a msgpack file (↔ the
+    reference's exportNodes/exportValues persistence, SURVEY.md §5
+    checkpoint/resume; dhtnode identity/state save in tools_common.h)."""
+    from ..utils import pack_msg
+    state = {"nodes": node.export_nodes(), "values": node.export_values()}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(pack_msg(state))
+    os.replace(tmp, path)
+
+
+def load_state(node: DhtRunner, path: str) -> Tuple[int, int]:
+    """Re-insert persisted nodes (bootstrap without ping, insertNode
+    semantics dht.h:109-119) and values (clamped creation dates).
+    Returns (n_nodes, n_keys)."""
+    from ..sockaddr import SockAddr as _SA
+    from ..utils import unpack_msg
+    with open(path, "rb") as f:
+        state = unpack_msg(f.read())
+    inserted = 0
+    for n in state.get("nodes", []):
+        try:
+            addr = _SA.from_compact(n["addr"]) \
+                if isinstance(n["addr"], (bytes, bytearray)) else n["addr"]
+            node.bootstrap_node(InfoHash(n["id"]), addr)
+            inserted += 1
+        except Exception:
+            continue
+    values = state.get("values", [])
+    node.import_values(values)
+    return inserted, len(values)
+
+
 def print_node_info(node: DhtRunner) -> None:
     """(↔ print_node_info, tools_common.h:97-107)"""
     print("OpenDHT-TPU node %s" % node.get_node_id())

@@ -258,9 +258,24 @@ def main(argv=None) -> int:
     p = make_arg_parser("OpenDHT-TPU node CLI")
     p.add_argument("--daemon", action="store_true",
                    help="run non-interactively (Ctrl-C to stop)")
+    p.add_argument("--save-state", default="",
+                   help="persist nodes+values to this file on exit and "
+                        "restore them on start (checkpoint/resume)")
     args = p.parse_args(argv)
     node = setup_node(args)
     print_node_info(node)
+    if args.save_state:
+        import os as _os
+        if _os.path.exists(args.save_state):
+            from .common import load_state
+            try:
+                n_nodes, n_keys = load_state(node, args.save_state)
+                print("restored %d nodes, %d keys from %s"
+                      % (n_nodes, n_keys, args.save_state))
+            except Exception as e:
+                # a corrupt state file must not keep the node from
+                # starting (the save path warns symmetrically)
+                print("state restore failed: %s" % e)
     proxy_server = None
     if args.proxyserver:
         from ..proxy import DhtProxyServer
@@ -275,6 +290,13 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if args.save_state:
+            try:
+                from .common import save_state
+                save_state(node, args.save_state)
+                print("state saved to %s" % args.save_state)
+            except Exception as e:
+                print("state save failed: %s" % e)
         if proxy_server:
             proxy_server.stop()
         node.join()

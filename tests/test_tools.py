@@ -42,6 +42,39 @@ def test_identity_save_load(tmp_path):
     assert ident.first.public_key().check_signature(b"data", sig)
 
 
+def test_state_save_load_roundtrip(tmp_path):
+    """Checkpoint/resume: nodes+values exported to a file come back on a
+    fresh runner (↔ exportNodes/exportValues persistence, SURVEY §5)."""
+    import time
+    from opendht_tpu.core.value import Value
+    from opendht_tpu.runtime.config import NodeStatus
+    from opendht_tpu.runtime.runner import DhtRunner
+    from opendht_tpu.tools.common import load_state, save_state
+
+    a, b = DhtRunner(), DhtRunner()
+    a.run(0)
+    b.run(0)
+    b.bootstrap("127.0.0.1", a.get_bound_port())
+    deadline = time.monotonic() + 20.0
+    while (b.get_status() is not NodeStatus.CONNECTED
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    key = InfoHash.get("state-key")
+    assert b.put_sync(key, Value(b"persisted"), timeout=20.0)
+    path = str(tmp_path / "state.mp")
+    save_state(b, path)
+    b.join()
+
+    c = DhtRunner()
+    c.run(0)
+    n_nodes, n_keys = load_state(c, path)
+    assert n_nodes >= 1 and n_keys >= 1
+    vals = c.get_sync(key, timeout=20.0)
+    assert any(v.data == b"persisted" for v in vals)
+    a.join()
+    c.join()
+
+
 def test_arg_parser_defaults():
     args = make_arg_parser("t").parse_args([])
     assert args.port == 0 and args.bootstrap == "" and not args.identity
