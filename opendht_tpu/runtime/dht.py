@@ -1591,10 +1591,17 @@ class Dht:
     def import_values(self, exported: List[tuple]) -> None:
         """(↔ Dht::importValues, src/dht.cpp:1992-2026)"""
         now = self.scheduler.time()
-        for key_raw, vals in exported:
-            key = InfoHash(key_raw)
-            for created_wall, packed in vals:
+        for entry in exported:
+            # one malformed entry must not abort the rest of the import
+            try:
+                key_raw, vals = entry
+                key = InfoHash(key_raw)
+            except Exception:
+                log.exception("skipping malformed import entry")
+                continue
+            for item in vals:
                 try:
+                    created_wall, packed = item
                     v = Value.from_packed(packed)
                 except Exception:
                     log.exception("failed to import value for %s", key)

@@ -135,11 +135,14 @@ class DhtRunner:
         dht.warmup()     # compile hot kernels before serving any packet
 
         self.running = True
-        if not config.threaded:
-            return
-        self._dht_thread = threading.Thread(
-            target=self._dht_loop, name="dht", daemon=True)
-        self._dht_thread.start()
+        if config.threaded:
+            self._dht_thread = threading.Thread(
+                target=self._dht_loop, name="dht", daemon=True)
+            self._dht_thread.start()
+        if config.proxy_server:
+            # start proxied (↔ DhtRunner::Config::proxy_server,
+            # dhtrunner.cpp:98-149 → enableProxy at startup)
+            self.enable_proxy(config.proxy_server)
 
     def _start_network(self, port: int, ipv6: bool) -> None:
         """(↔ DhtRunner::startNetwork, dhtrunner.cpp:511-608).  IPv4 goes

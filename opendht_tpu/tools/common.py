@@ -131,9 +131,13 @@ def load_state(node: DhtRunner, path: str) -> Tuple[int, int]:
     inserted = 0
     for n in state.get("nodes", []):
         try:
-            addr = _SA.from_compact(n["addr"]) \
-                if isinstance(n["addr"], (bytes, bytearray)) else n["addr"]
-            node.bootstrap_node(InfoHash(n["id"]), addr)
+            # after a msgpack round-trip addr can only be compact bytes;
+            # anything else is corrupt and would fail asynchronously on
+            # the DHT thread, so skip it here
+            if not isinstance(n["addr"], (bytes, bytearray)):
+                continue
+            node.bootstrap_node(InfoHash(n["id"]),
+                                _SA.from_compact(n["addr"]))
             inserted += 1
         except Exception:
             continue

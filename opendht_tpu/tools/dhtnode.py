@@ -264,6 +264,17 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     node = setup_node(args)
     print_node_info(node)
+    # SIGTERM (systemd/docker stop) must run the finally block so
+    # --save-state persists for daemon deployments
+    import signal as _signal
+
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass     # not the main thread / unsupported platform
     if args.save_state:
         import os as _os
         if _os.path.exists(args.save_state):

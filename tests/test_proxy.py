@@ -198,6 +198,25 @@ def test_runner_enable_proxy_hotswap(topology):
         c.join()
 
 
+def test_runner_config_proxy_server_startup(topology):
+    """RunnerConfig.proxy_server starts the node proxied from run()
+    (↔ DhtRunner::Config::proxy_server, dhtrunner.cpp:98-149)."""
+    peer, proxy_node, server, client = topology
+    c = DhtRunner()
+    c.run(0, RunnerConfig(proxy_server="127.0.0.1:%d" % server.port))
+    try:
+        assert wait_for(lambda: c.use_proxy, timeout=10.0)
+        assert wait_for(lambda: c.get_status() is NodeStatus.CONNECTED,
+                        timeout=25.0)
+        key = InfoHash.get("config-proxy-key")
+        assert c.put_sync(key, Value(b"from-config-proxy", value_id=71),
+                          timeout=25.0)
+        vals = peer.get_sync(key, timeout=20.0)
+        assert any(v.data == b"from-config-proxy" for v in vals)
+    finally:
+        c.join()
+
+
 def test_secure_dht_over_proxy(topology):
     """SecureDht wrapping the REST backend: signed put through the proxy,
     verified via UDP get (↔ the reference's SecureDhtProxy stack)."""

@@ -34,6 +34,29 @@ def two_nodes():
     b.join()
 
 
+def test_ipv6_dual_stack_put_get():
+    """Dual-stack runners bootstrap over ::1 and serve values on the v6
+    family (every table/search is duplicated per family, dht.h:370-381)."""
+    import socket
+    a, b = DhtRunner(), DhtRunner()
+    a.run(0, ipv6=True)
+    b.run(0, ipv6=True)
+    if a._sock6 is None or b._sock6 is None:
+        a.join(); b.join()
+        pytest.skip("no IPv6 loopback available")
+    try:
+        b.bootstrap("::1", a.get_bound_port())
+        assert wait_for(lambda: b.get_status(socket.AF_INET6)
+                        is NodeStatus.CONNECTED)
+        key = InfoHash.get("v6key")
+        assert b.put_sync(key, Value(b"over-six"), timeout=20.0)
+        vals = a.get_sync(key, timeout=20.0)
+        assert any(v.data == b"over-six" for v in vals)
+    finally:
+        a.join()
+        b.join()
+
+
 def test_bootstrap_connects(two_nodes):
     a, b = two_nodes
     assert a.get_bound_port() > 0 and b.get_bound_port() > 0

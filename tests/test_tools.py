@@ -51,28 +51,32 @@ def test_state_save_load_roundtrip(tmp_path):
     from opendht_tpu.runtime.runner import DhtRunner
     from opendht_tpu.tools.common import load_state, save_state
 
-    a, b = DhtRunner(), DhtRunner()
-    a.run(0)
-    b.run(0)
-    b.bootstrap("127.0.0.1", a.get_bound_port())
-    deadline = time.monotonic() + 20.0
-    while (b.get_status() is not NodeStatus.CONNECTED
-           and time.monotonic() < deadline):
-        time.sleep(0.05)
-    key = InfoHash.get("state-key")
-    assert b.put_sync(key, Value(b"persisted"), timeout=20.0)
-    path = str(tmp_path / "state.mp")
-    save_state(b, path)
-    b.join()
+    a, b, c = DhtRunner(), DhtRunner(), None
+    try:
+        a.run(0)
+        b.run(0)
+        b.bootstrap("127.0.0.1", a.get_bound_port())
+        deadline = time.monotonic() + 20.0
+        while (b.get_status() is not NodeStatus.CONNECTED
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        key = InfoHash.get("state-key")
+        assert b.put_sync(key, Value(b"persisted"), timeout=20.0)
+        path = str(tmp_path / "state.mp")
+        save_state(b, path)
+        b.join()
 
-    c = DhtRunner()
-    c.run(0)
-    n_nodes, n_keys = load_state(c, path)
-    assert n_nodes >= 1 and n_keys >= 1
-    vals = c.get_sync(key, timeout=20.0)
-    assert any(v.data == b"persisted" for v in vals)
-    a.join()
-    c.join()
+        c = DhtRunner()
+        c.run(0)
+        n_nodes, n_keys = load_state(c, path)
+        assert n_nodes >= 1 and n_keys >= 1
+        vals = c.get_sync(key, timeout=20.0)
+        assert any(v.data == b"persisted" for v in vals)
+    finally:
+        a.join()
+        b.join()
+        if c is not None:
+            c.join()
 
 
 def test_arg_parser_defaults():
