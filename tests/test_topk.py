@@ -176,3 +176,39 @@ def test_window_topk_small_n_valid():
     assert bool(np.asarray(cert).all())  # window covers everything
     idx = np.asarray(idx)
     assert ((idx >= 0).sum(axis=1) == 5).all()
+
+
+def test_prefix_lut_lower_bound_parity():
+    """The 2^16-prefix LUT lower bound is bit-identical to the plain
+    binary search, including on clustered tables where a LUT bucket
+    overflows LUT_BUCKET_STEPS coverage (certificate catches those)."""
+    from opendht_tpu.ops.sorted_table import build_prefix_lut
+
+    rng = np.random.default_rng(77)
+    raw = rng.integers(0, 256, size=(8192, 20), dtype=np.uint8)
+    # adversarial cluster: 6000 rows share the top 16 bits; with the
+    # shallow lut_steps=3 below, the in-bucket search cannot converge,
+    # so lut-path windows are misplaced and must be caught uncertified
+    raw[:6000, :2] = 0x41
+    ids = jnp.asarray(K.ids_from_bytes(raw))
+    sorted_ids, perm, n_valid = sort_table(ids)
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    q_raw = rng.integers(0, 256, size=(64, 20), dtype=np.uint8)
+    q_raw[:32, :2] = 0x41                    # half the queries hit the cluster
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    d1, i1, c1 = window_topk(sorted_ids, n_valid, q, k=8, window=64)
+    d2, i2, c2 = window_topk(sorted_ids, n_valid, q, k=8, window=64,
+                             lut=lut, lut_steps=3)
+    # the shallow search must leave some cluster queries uncertified —
+    # this is the overflow path the certificate exists to catch
+    assert not np.asarray(c2).all()
+    # certified rows of either path must equal the exact oracle
+    # (uncertified rows legitimately differ pre-fallback)
+    from opendht_tpu.ops.sorted_table import lookup_topk
+    da, ia, _ = lookup_topk(sorted_ids, n_valid, q, k=8, window=64)
+    cert1, cert2 = np.asarray(c1), np.asarray(c2)
+    assert np.array_equal(np.asarray(d1)[cert1], np.asarray(da)[cert1])
+    assert np.array_equal(np.asarray(d2)[cert2], np.asarray(da)[cert2])
+    # lut and plain agree wherever both certify
+    both = cert1 & cert2
+    assert np.array_equal(np.asarray(i1)[both], np.asarray(i2)[both])
