@@ -68,11 +68,12 @@ def main():
         return jax.block_until_ready(outs)
 
     # the device path (and the axon tunnel in particular) warms up over
-    # the first few dispatches; time several reps and take the best
-    for _ in range(3):
+    # the first dispatches and throughput drifts in phases over minutes;
+    # warm thoroughly and take the best of a longer rep train
+    for _ in range(5):
         outs = run_all()           # compile + warm
     rate = 0.0
-    for _ in range(5):
+    for _ in range(10):
         t0 = time.perf_counter()
         outs = run_all()
         dt = time.perf_counter() - t0

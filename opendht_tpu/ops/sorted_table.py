@@ -226,7 +226,8 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
 
 
 def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
-                fallback: bool = True, lut=None):
+                fallback: bool = True, lut=None,
+                lut_steps: int = LUT_BUCKET_STEPS):
     """Window lookup with exact fallback: uncertified queries re-run
     through the full-scan oracle so the result is always exact (when
     ``fallback=True``; with ``fallback=False`` rows where the returned
@@ -237,7 +238,8 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     idx [Q,k] int32 into the *sorted* table, certified [Q] bool).
     """
     dist, idx, cert = window_topk(sorted_ids, n_valid, queries, k=k,
-                                  window=window, lut=lut)
+                                  window=window, lut=lut,
+                                  lut_steps=lut_steps)
     if not fallback:
         return dist, idx, cert
     cert_host = jax.device_get(cert)

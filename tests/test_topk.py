@@ -204,8 +204,7 @@ def test_prefix_lut_lower_bound_parity():
     assert not np.asarray(c2).all()
     # certified rows of either path must equal the exact oracle
     # (uncertified rows legitimately differ pre-fallback)
-    from opendht_tpu.ops.sorted_table import lookup_topk
-    da, ia, _ = lookup_topk(sorted_ids, n_valid, q, k=8, window=64)
+    da, _, _ = lookup_topk(sorted_ids, n_valid, q, k=8, window=64)
     cert1, cert2 = np.asarray(c1), np.asarray(c2)
     assert np.array_equal(np.asarray(d1)[cert1], np.asarray(da)[cert1])
     assert np.array_equal(np.asarray(d2)[cert2], np.asarray(da)[cert2])
