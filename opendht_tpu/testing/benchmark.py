@@ -93,12 +93,12 @@ def main(argv=None) -> int:
         p.error("--real is only implemented for -t gets")
 
     import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")   # keep off the TPU tunnel
-    except Exception as e:
+    from ..tools.common import force_cpu_jax
+    force_cpu_jax()
+    if jax.default_backend() != "cpu":
         # the axon TPU tunnel admits one client; never grab it by accident
-        p.exit(1, "could not pin JAX to CPU (%s); refusing to risk the "
-                  "single-client TPU tunnel\n" % e)
+        p.exit(1, "could not pin JAX to CPU; refusing to risk the "
+                  "single-client TPU tunnel\n")
 
     if args.test == "gets":
         out = run_gets_real(args) if args.real else run_gets_virtual(args)

@@ -17,6 +17,17 @@ from ..runtime.config import Config
 from ..runtime.runner import DhtRunner, RunnerConfig
 
 
+def force_cpu_jax() -> None:
+    """Pin JAX to the CPU backend (host tools must never grab the
+    single-client TPU tunnel; accelerator init would also stall the
+    protocol thread — see setup_node's --tpu flag)."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
 def make_arg_parser(description: str) -> argparse.ArgumentParser:
     """(↔ parseArgs, tools_common.h:120-210)"""
     p = argparse.ArgumentParser(description=description)
@@ -84,11 +95,7 @@ def setup_node(args) -> DhtRunner:
     if args.verbose:
         logging.basicConfig(level=logging.DEBUG)
     if not getattr(args, "tpu", False):
-        try:
-            import jax
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+        force_cpu_jax()
     ident = None
     if args.save_identity:
         ident = load_identity(args.save_identity)
