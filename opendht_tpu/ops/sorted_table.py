@@ -27,7 +27,19 @@ O(Q·(log N + W)) — the difference between 1M×10M = 10^13 limb ops and
 All steps are static-shape, batched, and jit/shard_map friendly:
 binary search is a fixed ``ceil(log2 N)``-step ``fori_loop``; the window
 merge is one 7-key lexicographic sort (see ops/xor_topk.py for the key
-layout).
+layout) or the pallas selection kernel (ops/pallas_select.py).
+
+Negative result (recorded so it isn't retried): fusing the window
+*gather* into a pallas kernel — DMAing each query's window straight
+from the HBM-resident table via scalar-prefetched start offsets — does
+not work on TPU.  Mosaic requires slice offsets aligned to the memref
+tiling (1024 elements for 1-D int32, 8 sublanes for 2-D), so arbitrary
+per-query window starts either fail to compile or force the window to
+be widened ~8× to the alignment grid, destroying the HBM-traffic
+saving that motivated the fusion.  XLA's general gather handles the
+unaligned access pattern natively; the win that *was* available —
+replacing the post-gather sort with VPU min-extraction — is
+ops/pallas_select.py.
 """
 
 from __future__ import annotations
