@@ -1,0 +1,72 @@
+"""Driver entry-point checks.
+
+Round-1 regression: the driver runs ``dryrun_multichip`` in a fresh
+process whose default backend is the single-chip TPU tunnel, and the
+round-1 build relied on the *caller* provisioning the 8-device virtual
+CPU platform — so the driver's check crashed (MULTICHIP_r01.json rc=1)
+even though the sharded code was correct.  ``_provision_devices`` now
+applies the conftest recipe itself; these tests pin both execution
+environments.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    dist, rows, cert = jax.jit(fn)(*args)
+    assert rows.shape == (256, 8)
+    assert bool(cert.all())
+
+
+def test_dryrun_multichip_warm_backend():
+    # With the backend warm (8 virtual CPU devices), the guard must
+    # detect it, leave it alone, and still pass.  Initialize explicitly
+    # so the warm path is exercised regardless of test selection order.
+    assert len(jax.devices()) == 8
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_cold_process():
+    # The driver condition: fresh interpreter, no XLA_FLAGS, default
+    # platform.  dryrun_multichip must self-provision.
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_dryrun_multichip_stale_smaller_flag():
+    # A wrapper already exported a *smaller* forced-device count; the
+    # provisioner must replace it with max(n_devices, prior), not skip on
+    # a substring match (round-2 review finding).
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_provision_refuses_oversubscription():
+    # Backend warm with 8 devices; asking for more must raise the
+    # actionable error, not crash downstream in make_mesh.  Warm it
+    # explicitly so the test holds when run in isolation.
+    assert len(jax.devices()) == 8
+    import pytest
+    import __graft_entry__ as g
+    with pytest.raises(RuntimeError, match="fresh process"):
+        g._provision_devices(64)
