@@ -18,6 +18,15 @@ def _u8(buf) -> "ctypes.POINTER(ctypes.c_uint8)":
     return (ctypes.c_uint8 * len(buf)).from_buffer_copy(bytes(buf))
 
 
+def _lib():
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "native library unavailable (no C++ toolchain or build failed); "
+            "check opendht_tpu.native.available() before calling")
+    return lib
+
+
 def _rows(arr) -> np.ndarray:
     a = np.ascontiguousarray(np.asarray(arr, dtype=np.uint8))
     if a.ndim != 2 or a.shape[1] != _HASH_LEN:
@@ -27,19 +36,19 @@ def _rows(arr) -> np.ndarray:
 
 def xor_cmp(self_id: bytes, a: bytes, b: bytes) -> int:
     """infohash.h:179-194 semantics; requires the native lib."""
-    lib = get_lib()
+    lib = _lib()
     return lib.dht_xor_cmp(_u8(self_id), _u8(a), _u8(b))
 
 
 def common_bits(a: bytes, b: bytes) -> int:
-    lib = get_lib()
+    lib = _lib()
     return lib.dht_common_bits(_u8(a), _u8(b))
 
 
 def sort_ids(ids) -> Tuple[np.ndarray, np.ndarray]:
     """Lexicographic sort of an [N,20] id matrix; returns
     (sorted_ids, perm int32[N])."""
-    lib = get_lib()
+    lib = _lib()
     a = _rows(ids).copy()
     perm = np.empty(a.shape[0], dtype=np.int32)
     lib.dht_sort_ids(
@@ -56,7 +65,7 @@ def sorted_closest(sorted_ids, queries, k: int = 8,
     (window plays the same role as the device kernel's, see
     ops/sorted_table.py).  Returns int32 [Q,k] sorted-table indices,
     -1 padded."""
-    lib = get_lib()
+    lib = _lib()
     t = _rows(sorted_ids)
     q = _rows(queries)
     out = np.empty((q.shape[0], k), dtype=np.int32)
@@ -69,7 +78,7 @@ def sorted_closest(sorted_ids, queries, k: int = 8,
 
 def scan_closest(ids, queries, k: int = 8) -> np.ndarray:
     """Exact full-scan oracle (insertion scan), int32 [Q,k]."""
-    lib = get_lib()
+    lib = _lib()
     t = _rows(ids)
     q = _rows(queries)
     out = np.empty((q.shape[0], k), dtype=np.int32)
@@ -94,14 +103,13 @@ class UdpEngine:
     def __init__(self, port: int = 0, *, ring_size: int = 16384,
                  global_rps: int = 1600, per_ip_rps: int = 200,
                  exempt_loopback: bool = True):
-        lib = get_lib()
-        if lib is None:
-            raise RuntimeError("native library unavailable")
+        lib = _lib()
         self._lib = lib
         self._h = lib.dht_udp_create(port, ring_size, global_rps, per_ip_rps,
                                      1 if exempt_loopback else 0)
         if not self._h:
             raise OSError("could not bind UDP port %d" % port)
+        self._owned = True
         self.port = lib.dht_udp_port(self._h)
         self._buf = (ctypes.c_uint8 * (64 * 1024))()
         self._nbytes = ctypes.c_uint64(0)
@@ -149,9 +157,18 @@ class UdpEngine:
                 "queued": s[5]}
 
     def close(self) -> None:
-        if self._h:
+        if self._h and self._owned:
             self._lib.dht_udp_destroy(self._h)
             self._h = None
+
+    def detach(self) -> None:
+        """Give up ownership without freeing the engine.  Used when a
+        receiver thread may still be blocked inside wait()/poll(): a
+        destroy would free the Engine under that thread (use-after-free),
+        so the owner deliberately leaks it.  ``_h`` stays valid — the
+        stuck thread may still be dereferencing it — only the ownership
+        flag flips, so close()/__del__ become no-ops."""
+        self._owned = False
 
     def __enter__(self) -> "UdpEngine":
         return self

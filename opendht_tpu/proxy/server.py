@@ -174,6 +174,8 @@ class DhtProxyServer:
                 except Exception:
                     pass
             for rec in push_expired_records:
+                if rec.token is None:   # backend listen still registering;
+                    continue            # do_SUBSCRIBE's re-check cancels it
                 try:
                     self._runner.cancel_listen(rec.key, rec.token)
                 except Exception:
@@ -382,8 +384,12 @@ def _make_handler(server: DhtProxyServer):
             try:
                 ok = done.get(timeout=30.0)
             except queue.Empty:
-                ok = False
-            if permanent and value.id != Value.INVALID_ID:
+                ok = None   # unknown: the put may still land on the DHT
+            # track refresh bookkeeping unless the DHT definitively
+            # rejected the put; an unknown (timed-out) permanent put is
+            # recorded so the maintenance sweep cancels it at deadline
+            # instead of leaking it on the DHT forever
+            if ok is not False and permanent and value.id != Value.INVALID_ID:
                 with server._lock:
                     server._puts[(key, value.id)] = _PermanentPut(
                         value, time.monotonic() + timeout)
@@ -485,6 +491,19 @@ def _make_handler(server: DhtProxyServer):
                 return True
 
             rec.token = runner.listen(key, cb)
+            # a concurrent UNSUBSCRIBE (or expiry sweep) may have removed
+            # the record while the backend listen was registering; tear
+            # the fresh listener down instead of leaking it
+            with server._lock:
+                still_mine = server._push_listeners.get(
+                    (key, client_id)) is rec
+            if not still_mine:
+                try:
+                    runner.cancel_listen(key, rec.token)
+                except Exception:
+                    pass
+                self._err(410, "unsubscribed")
+                return
             self._send_json({"token": id(rec)})
 
         def do_UNSUBSCRIBE(self):
@@ -500,7 +519,7 @@ def _make_handler(server: DhtProxyServer):
             with server._lock:
                 rec = server._push_listeners.pop((key, client_id), None)
                 server.stats.push_listeners_count = len(server._push_listeners)
-            if rec is not None:
+            if rec is not None and rec.token is not None:
                 try:
                     runner.cancel_listen(rec.key, rec.token)
                 except Exception:

@@ -699,7 +699,16 @@ class DhtRunner:
                 sock.close()
         self._sock4 = self._sock6 = None
         if self._udp is not None:
-            self._udp.close()
+            if self._native_thread is not None and \
+                    self._native_thread.is_alive():
+                # receiver thread failed to join within timeout and may
+                # still be blocked in the engine: freeing it would be a
+                # use-after-free, so leak the handle instead
+                log.warning("native receiver thread did not join; "
+                            "leaking UDP engine handle")
+                self._udp.detach()
+            else:
+                self._udp.close()
             self._udp = None
         self._native_thread = None
         if self._stop_rd is not None:

@@ -162,3 +162,15 @@ def test_udp_batch_poll():
         assert len(got) == 20
         assert [p[1] for p in got] == \
             [("msg-%02d" % i).encode() for i in range(20)]
+
+
+def test_helpers_raise_without_lib(monkeypatch):
+    # On hosts without a toolchain get_lib() returns None; module-level
+    # helpers must raise the actionable RuntimeError, not AttributeError.
+    import pytest
+    from opendht_tpu.native import wrappers
+    monkeypatch.setattr(wrappers, "get_lib", lambda: None)
+    with pytest.raises(RuntimeError, match="native library unavailable"):
+        wrappers.common_bits(b"\0" * 20, b"\0" * 20)
+    with pytest.raises(RuntimeError, match="native library unavailable"):
+        wrappers.UdpEngine(0)
