@@ -23,10 +23,13 @@ include/opendht/crypto.h:67-496, src/crypto.cpp) on top of the
   (crypto.h:468-496).
 - ``aes_encrypt/aes_decrypt`` — AES-GCM, layout IV(12)‖ciphertext‖tag(16)
   (src/crypto.cpp:119-191); password variants prefix a 16-byte salt.
-- ``stretch_key`` — password KDF.  The reference uses argon2i(t=16,
-  m=64MiB, p=1) (src/crypto.cpp:193-206); argon2 is not available here so
-  we use scrypt(n=2^15, r=8, p=1), which only affects locally-stored
-  password-encrypted blobs, never the wire format.
+- ``stretch_key`` — password KDF: argon2i(t=16, m=64MiB, p=1) → 32 bytes
+  → length-selected digest, exactly the reference's stretchKey
+  (src/crypto.cpp:193-206), via argon2-cffi (the official phc-winner
+  C implementation).  Round-1 used scrypt(n=2^15, r=8, p=1) as a
+  stand-in; ``aes_decrypt_password`` still falls back to the scrypt key
+  so blobs written by round-1 builds remain readable (legacy path,
+  local storage only — never the wire format).
 
 ``Identity = (PrivateKey, Certificate)`` as in crypto.h:62.
 """
@@ -44,6 +47,9 @@ from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 from cryptography.x509.oid import ExtensionOID, NameOID
 import hashlib
+
+from argon2.low_level import hash_secret_raw as _argon2_raw
+from argon2.low_level import Type as _Argon2Type
 
 from .infohash import InfoHash, PkId, _digest_for_len
 from .utils import DhtException
@@ -101,13 +107,26 @@ def aes_decrypt(data: bytes, key: bytes) -> bytes:
 
 
 def stretch_key(password: str, salt: Optional[bytes], key_length: int = 32):
-    """Password → key.  Returns (key, salt).  (src/crypto.cpp:193-206;
-    scrypt stands in for argon2i — see module docstring.)"""
+    """Password → key.  Returns (key, salt).
+
+    argon2i(t=16, m=64MiB, p=1, out=32) then the length-selected digest,
+    byte-compatible with the reference stretchKey
+    (src/crypto.cpp:193-206: argon2i_hash_raw(16, 64*1024, 1, ...) then
+    hash(res, key_length))."""
     if not salt:
         salt = secrets.token_bytes(PASSWORD_SALT_LENGTH)
+    raw = _argon2_raw(password.encode(), salt, time_cost=16,
+                      memory_cost=64 * 1024, parallelism=1, hash_len=32,
+                      type=_Argon2Type.I)
+    return _digest_for_len(raw, key_length), salt
+
+
+def _stretch_key_scrypt(password: str, salt: bytes, key_length: int = 32):
+    """Round-1 legacy KDF (scrypt stand-in), kept so blobs written
+    before the argon2i switch stay decryptable."""
     raw = hashlib.scrypt(password.encode(), salt=salt, n=2 ** 15, r=8, p=1,
                          maxmem=64 * 1024 * 1024, dklen=32)
-    return _digest_for_len(raw, key_length), salt
+    return _digest_for_len(raw, key_length)
 
 
 def aes_encrypt_password(data: bytes, password: str) -> bytes:
@@ -120,7 +139,12 @@ def aes_decrypt_password(data: bytes, password: str) -> bytes:
         raise DecryptError("Wrong data size")
     salt = data[:PASSWORD_SALT_LENGTH]
     key, _ = stretch_key(password, salt, 256 // 8)
-    return aes_decrypt(data[PASSWORD_SALT_LENGTH:], key)
+    try:
+        return aes_decrypt(data[PASSWORD_SALT_LENGTH:], key)
+    except DecryptError:
+        # legacy: blob may have been written by a round-1 (scrypt) build
+        key = _stretch_key_scrypt(password, salt, 256 // 8)
+        return aes_decrypt(data[PASSWORD_SALT_LENGTH:], key)
 
 
 def hash_data(data: bytes, hash_len: int = 64) -> bytes:

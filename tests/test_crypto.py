@@ -89,6 +89,40 @@ def test_stretch_key_deterministic():
     assert k3 != k1
 
 
+def test_argon2i_public_vector():
+    # phc-winner-argon2 test.c: argon2i v1.3, t=2, m=2^16 KiB, p=1,
+    # "password"/"somesalt" — pins that the KDF backing stretch_key is
+    # real argon2i, not a stand-in.
+    from argon2.low_level import hash_secret_raw, Type
+    out = hash_secret_raw(b"password", b"somesalt", time_cost=2,
+                          memory_cost=65536, parallelism=1, hash_len=32,
+                          type=Type.I)
+    assert out.hex() == ("c1628832147d9720c5bd1cfd61367078"
+                         "729f6dfb6f8fea9ff98158e0d7816ed0")
+
+
+def test_stretch_key_known_answer():
+    # Frozen output of the reference stretchKey pipeline
+    # (src/crypto.cpp:193-206): argon2i(t=16, m=64MiB, p=1, out=32)
+    # then the length-selected digest.  Computed once with argon2-cffi
+    # (official phc C implementation) and pinned so param drift fails.
+    salt = b"\x02" * 16
+    k32, _ = crypto.stretch_key("test password", salt, 32)
+    assert k32.hex() == ("ac0c1cd67e16026dc8d1fdc3aa5e69ba"
+                         "85035bcddc56d6aa87bc0b4424c4f1ab")
+
+
+def test_password_decrypt_scrypt_legacy():
+    # Blobs written by round-1 builds (scrypt KDF) must stay readable.
+    import hashlib
+    salt = b"\x07" * crypto.PASSWORD_SALT_LENGTH
+    raw = hashlib.scrypt(b"hunter2", salt=salt, n=2 ** 15, r=8, p=1,
+                         maxmem=64 * 1024 * 1024, dklen=32)
+    legacy_key = crypto.hash_data(raw, 32)
+    blob = salt + crypto.aes_encrypt(b"old data", legacy_key)
+    assert crypto.aes_decrypt_password(blob, "hunter2") == b"old data"
+
+
 def test_hash_by_length():
     import hashlib
     d = b"data"
