@@ -301,13 +301,22 @@ class DhtProxyClient:
         return True
 
     # ------------------------------------------------------ push (SUBSCRIBE)
-    def subscribe(self, key: InfoHash) -> Optional[dict]:
+    def subscribe(self, key: InfoHash, *, push_token: str = "",
+                  platform: str = "android",
+                  token: int = 0) -> Optional[dict]:
         """Register for push notifications (dht_proxy_client.cpp:622-700).
-        Requires a ``client_id`` (the reference's push device token)."""
+        Requires a ``client_id``; ``push_token``/``platform``/``token``
+        are the gateway fields the reference sends (body "key",
+        "platform", "token" — dht_proxy_server.cpp:404-412)."""
         if not self.client_id:
             return None
-        return self._request_json("SUBSCRIBE", "/" + key.hex(),
-                                  {"client_id": self.client_id})
+        body = {"client_id": self.client_id}
+        if push_token:
+            body["key"] = push_token
+            body["platform"] = platform
+        if token:
+            body["token"] = token
+        return self._request_json("SUBSCRIBE", "/" + key.hex(), body)
 
     def unsubscribe(self, key: InfoHash) -> Optional[dict]:
         if not self.client_id:

@@ -41,9 +41,11 @@ from ..infohash import InfoHash
 from ..core.value import Value
 from .json_codec import value_to_json, value_from_json, permanent_deadline
 
-# reference: proxy::OP_TIMEOUT/OP_MARGIN (include/opendht/proxy.h) —
-# permanent ops expire server-side unless the client refreshes them.
-OP_TIMEOUT = 10 * 60.0
+# reference: proxy::OP_TIMEOUT/OP_MARGIN (include/opendht/proxy.h:25-26) —
+# permanent ops expire server-side unless the client refreshes them; a
+# refresh push is sent OP_MARGIN before expiry (dht_proxy_server.cpp:462-470).
+OP_TIMEOUT = 60 * 60.0
+OP_MARGIN = 5 * 60.0
 STATS_PERIOD = 120.0            # dht_proxy_server.cpp:138-148
 
 
@@ -79,13 +81,20 @@ class _PermanentPut:
 
 
 class _PushListener:
-    __slots__ = ("key", "client_id", "token", "deadline")
+    __slots__ = ("key", "client_id", "token", "deadline",
+                 "push_token", "is_android", "client_token", "refresh_sent")
 
-    def __init__(self, key: InfoHash, client_id: str, token, deadline: float):
+    def __init__(self, key: InfoHash, client_id: str, token, deadline: float,
+                 push_token: str = "", is_android: bool = True,
+                 client_token: int = 0):
         self.key = key
         self.client_id = client_id
-        self.token = token
+        self.token = token              # backend (runner.listen) token
         self.deadline = deadline
+        self.push_token = push_token    # gateway device token (body "key")
+        self.is_android = is_android    # body "platform" == "android"
+        self.client_token = client_token  # client's token number (body "token")
+        self.refresh_sent = False       # expiry-refresh push dispatched
 
 
 class DhtProxyServer:
@@ -93,9 +102,18 @@ class DhtProxyServer:
 
     def __init__(self, runner, port: int = 8080, *,
                  push_sender: Optional[Callable[[str, dict], None]] = None,
+                 push_server: Optional[str] = None,
                  address: str = "127.0.0.1"):
+        """``push_server`` ("host:port") enables the HTTP Gorush gateway
+        client (↔ the reference's pushServer ctor arg,
+        dht_proxy_server.cpp:96-136); ``push_sender`` is the injectable
+        callback alternative, kept for tests and embedding."""
         self._runner = runner
         self._push_sender = push_sender
+        self._gorush = None
+        if push_server:
+            from .push import GorushPushSender
+            self._gorush = GorushPushSender(push_server)
         self.stats = ServerStats()
         self._req_times: list = []
         self._lock = threading.Lock()
@@ -121,6 +139,8 @@ class DhtProxyServer:
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._gorush is not None:
+            self._gorush.join()
 
     def get_stats(self) -> ServerStats:
         return self.stats
@@ -180,6 +200,21 @@ class DhtProxyServer:
                     self._runner.cancel_listen(rec.key, rec.token)
                 except Exception:
                     pass
+            # refresh pushes: OP_MARGIN before a listener expires, tell
+            # the client to re-subscribe (dht_proxy_server.cpp:462-470:
+            # expireNotifyJob sends {"timeout": key, "to", "token"})
+            with self._lock:
+                refresh = [l for l in self._push_listeners.values()
+                           if not l.refresh_sent
+                           and l.deadline - OP_MARGIN <= now]
+                for l in refresh:
+                    l.refresh_sent = True
+            for rec in refresh:
+                self._notify_push(rec, {
+                    "timeout": rec.key.hex(),
+                    "to": rec.client_id,
+                    "token": str(rec.client_token),
+                })
             if now - last_stats >= STATS_PERIOD or last_stats == 0.0:
                 last_stats = now
                 try:
@@ -187,12 +222,20 @@ class DhtProxyServer:
                 except Exception:
                     pass
 
-    # Push notifications: the reference POSTs {key, client_id, data} to a
-    # Gorush gateway (:411-469); here the gateway is the injected callback.
-    def _notify_push(self, client_id: str, payload: dict) -> None:
+    # Push notifications: the Gorush HTTP gateway gets the reference's
+    # exact data shape (dht_proxy_server.cpp:446-470); the injected
+    # callback additionally receives `extra` (value ids) for embedders.
+    def _notify_push(self, rec: _PushListener, data: dict,
+                     extra: Optional[dict] = None) -> None:
+        if self._gorush is not None and rec.push_token:
+            try:
+                self._gorush.notify(rec.push_token, data, rec.is_android)
+            except Exception:
+                pass
         if self._push_sender is not None:
             try:
-                self._push_sender(client_id, payload)
+                self._push_sender(rec.client_id,
+                                  dict(data, **extra) if extra else data)
             except Exception:
                 pass
 
@@ -466,28 +509,48 @@ def _make_handler(server: DhtProxyServer):
             if not client_id:
                 self._err(400, "missing client_id")
                 return
+            # gateway fields (dht_proxy_server.cpp:404-412): "key" is the
+            # device push token, "platform" selects android/ios payloads,
+            # "token" is the client's own listen-token number
+            push_token = str(obj.get("key", ""))
+            is_android = str(obj.get("platform", "android")) == "android"
+            try:
+                client_token = int(obj.get("token", 0) or 0)
+            except (TypeError, ValueError):
+                client_token = 0
             # reserve the slot under the lock so concurrent subscribes for
             # the same (key, client_id) can't both register a listener
             rec = _PushListener(key, client_id, None,
-                                time.monotonic() + OP_TIMEOUT)
+                                time.monotonic() + OP_TIMEOUT,
+                                push_token=push_token, is_android=is_android,
+                                client_token=client_token)
             with server._lock:
                 existing = server._push_listeners.get((key, client_id))
                 if existing is not None:       # refresh (:436-442)
                     existing.deadline = time.monotonic() + OP_TIMEOUT
+                    existing.refresh_sent = False
+                    existing.push_token = push_token or existing.push_token
+                    existing.is_android = is_android
+                    if client_token:
+                        existing.client_token = client_token
                 else:
                     server._push_listeners[(key, client_id)] = rec
                     server.stats.push_listeners_count = \
                         len(server._push_listeners)
             if existing is not None:
-                self._send_json({"token": id(existing)})
+                self._send_json(
+                    {"token": existing.client_token or id(existing)})
                 return
 
             def cb(values, expired):
-                server._notify_push(client_id, {
-                    "key": key.hex(),
-                    "expired": bool(expired),
-                    "ids": [v.id for v in values],
-                })
+                # reference data shape :446-453; ids/expired ride along
+                # for the injected-callback embedders
+                server._notify_push(
+                    rec,
+                    {"key": key.hex(), "to": client_id,
+                     "token": str(rec.client_token)},
+                    extra={"expired": bool(expired),
+                           "ids": [v.id for v in values]})
                 return True
 
             rec.token = runner.listen(key, cb)
@@ -504,7 +567,7 @@ def _make_handler(server: DhtProxyServer):
                     pass
                 self._err(410, "unsubscribed")
                 return
-            self._send_json({"token": id(rec)})
+            self._send_json({"token": rec.client_token or id(rec)})
 
         def do_UNSUBSCRIBE(self):
             """dht_proxy_server.cpp:548-554."""

@@ -163,6 +163,76 @@ def test_subscribe_push_notifications(topology):
         server._push_sender = None
 
 
+def test_push_gateway_http(topology):
+    """A SUBSCRIBE with gateway fields drives real Gorush-shaped POSTs to
+    an HTTP push server on value arrival, and a refresh push near expiry
+    (dht_proxy_server.cpp:411-469 subscribe, :548-583 sender,
+    :462-470 expireNotifyJob)."""
+    import http.server
+    import threading
+
+    peer, proxy_node, server, client = topology
+    got = []
+
+    class FakeGorush(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            got.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, fmt, *args):
+            pass
+
+    gw = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeGorush)
+    gw_thread = threading.Thread(target=gw.serve_forever, daemon=True)
+    gw_thread.start()
+
+    from opendht_tpu.proxy.push import GorushPushSender
+    server._gorush = GorushPushSender("127.0.0.1:%d" % gw.server_address[1])
+    try:
+        push_client = DhtProxyClient("127.0.0.1", server.port,
+                                     client_id="gw-client")
+        key = InfoHash.get("gorush-key")
+        res = push_client.subscribe(key, push_token="device-token-xyz",
+                                    platform="ios", token=777)
+        assert res is not None and res.get("token") == 777
+        time.sleep(1.0)
+        assert peer.put_sync(key, Value(b"notify-me", value_id=91),
+                             timeout=20.0)
+        assert wait_for(lambda: len(got) > 0, timeout=25.0)
+        path, payload = got[0]
+        assert path == "/api/push"
+        n = payload["notifications"][0]
+        assert n["tokens"] == ["device-token-xyz"]
+        assert n["platform"] == 1            # ios
+        assert n["priority"] == "high" and n["time_to_live"] == 600
+        assert n["data"]["key"] == key.hex()
+        assert n["data"]["to"] == "gw-client"
+        assert n["data"]["token"] == "777"
+
+        # force the expiry-refresh window and expect the "timeout" push
+        with server._lock:
+            rec = server._push_listeners[(key, "gw-client")]
+            rec.deadline = time.monotonic() + 1.0   # within OP_MARGIN
+        assert wait_for(lambda: any("timeout" in p["notifications"][0]["data"]
+                                    for _, p in got), timeout=10.0), got
+        refresh = next(p for _, p in got
+                       if "timeout" in p["notifications"][0]["data"])
+        d = refresh["notifications"][0]["data"]
+        assert d["timeout"] == key.hex() and d["token"] == "777"
+
+        assert push_client.unsubscribe(key).get("ok") is True
+        push_client.join()
+    finally:
+        server._gorush.join()
+        server._gorush = None
+        gw.shutdown()
+        gw.server_close()
+
+
 def test_runner_enable_proxy_hotswap(topology):
     """A third runner switches its backend to the REST proxy, ops and the
     live listener carry over, then it swaps back (dhtrunner.cpp:992-1041,
