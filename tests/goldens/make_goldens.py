@@ -1,0 +1,187 @@
+"""Generate the frozen wire-format golden fixtures.
+
+Each golden is a byte-exact packet a reference peer emits/accepts, built
+with the independent mini_msgpack encoder by transcribing the reference's
+pack calls one for one (file:line cited per message).  The .bin files are
+checked in; tests/test_goldens.py asserts our NetworkEngine emits these
+exact bytes and parses them back.  Regenerate with::
+
+    python tests/goldens/make_goldens.py
+
+Interop context: building the reference C++ node in this environment was
+attempted and is impossible — `cmake /root/reference -DOPENDHT_TOOLS=ON`
+fails at configure with "Could NOT find GnuTLS (missing: GNUTLS_LIBRARY
+GNUTLS_INCLUDE_DIR)"; msgpack-c and GnuTLS dev headers are not installed
+and cannot be (no package installs).  These fixtures are the fallback
+prescribed by the build plan: an independent encoding of the documented
+wire layout (src/network_engine.cpp:677-1305, include/opendht/value.h).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mini_msgpack import (  # noqa: E402
+    p_array, p_bin, p_bool, p_int, p_map, p_str, p_uint,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# ---------------------------------------------------------------- fixed inputs
+MYID = bytes(range(20))                   # engine's own id
+TARGET = b"\xaa" * 20                     # find target
+HASH = b"\xbb" * 20                       # get/listen/announce key
+TID = 0x01020304                          # TransId (big-endian bin4)
+TID_BIN = b"\x01\x02\x03\x04"
+SID = 0x05060709                          # listen socket id
+SID_BIN = b"\x05\x06\x07\x09"
+TOKEN = bytes(range(0x10, 0x18))          # 8-byte write token
+SA4 = b"\x0a\x00\x00\x09"                 # 10.0.0.9 (reply "sa" = addr only)
+CREATED = 1_700_000_000
+VID = 42
+NET = 7
+AF_INET, AF_INET6 = 2, 10
+AGENT = "RNG1"                            # network_engine.cpp:55
+
+# two IPv4 nodes + one IPv6 node as compact SEND_NODES triples
+# (bufferNodes, network_engine.cpp:1003-1034: id ‖ in_addr ‖ be16 port)
+N4_BLOB = (b"\xc1" * 20 + b"\x0a\x00\x00\x01" + (4000).to_bytes(2, "big")
+           + b"\xc2" * 20 + b"\x0a\x00\x00\x02" + (4001).to_bytes(2, "big"))
+N6_BLOB = (b"\xd1" * 20 + b"\x00" * 15 + b"\x01"
+           + (4002).to_bytes(2, "big"))
+
+
+def kv(k: str, v: bytes) -> bytes:
+    return p_str(k) + v
+
+
+def outer(pairs, network: int = 0) -> bytes:
+    """Trailer shared by every message: t, y, v[, n] after the body keys
+    (network_engine.cpp:677-1305)."""
+    return p_map(len(pairs) + (1 if network else 0)) + b"".join(pairs) + (
+        kv("n", p_int(network)) if network else b"")
+
+
+def trailer(tid_bin: bytes, y: str) -> list:
+    return [kv("t", p_bin(tid_bin)), kv("y", p_str(y)),
+            kv("v", p_str(AGENT))]
+
+
+def value_plain(vid: int, type_id: int, data: bytes,
+                user_type: str = "") -> bytes:
+    """Unsigned Value: {id, dat:{body:{type,data[,utype]}}}
+    (value.h:470-511)."""
+    body = (p_map(2 + (1 if user_type else 0))
+            + kv("type", p_int(type_id)) + kv("data", p_bin(data))
+            + (kv("utype", p_str(user_type)) if user_type else b""))
+    dat = p_map(1) + kv("body", body)
+    return p_map(2) + kv("id", p_uint(vid)) + kv("dat", dat)
+
+
+V1 = value_plain(VID, 3, b"hello world")
+V2 = value_plain(43, 0, b"second value", user_type="text/plain")
+
+
+def make_goldens() -> dict:
+    g = {}
+
+    # ping request (network_engine.cpp:677-695)
+    body = p_map(1) + kv("id", p_bin(MYID))
+    g["ping_req"] = outer([kv("a", body), kv("q", p_str("ping"))]
+                          + trailer(TID_BIN, "q"))
+    # same, non-zero network id appended (cpp:692-694)
+    g["ping_req_net7"] = outer([kv("a", body), kv("q", p_str("ping"))]
+                               + trailer(TID_BIN, "q"), network=NET)
+
+    # pong / listen confirmation (cpp:715-731, 1119-1133)
+    rbody = p_map(2) + kv("id", p_bin(MYID)) + kv("sa", p_bin(SA4))
+    g["pong"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
+    # find_node request with want [v4, v6] (cpp:738-768)
+    abody = (p_map(3) + kv("id", p_bin(MYID)) + kv("target", p_bin(TARGET))
+             + kv("w", p_array(2) + p_int(AF_INET) + p_int(AF_INET6)))
+    g["find_req"] = outer([kv("a", abody), kv("q", p_str("find"))]
+                          + trailer(TID_BIN, "q"))
+
+    # get_values request, no query/want (cpp:772-808)
+    abody = p_map(2) + kv("id", p_bin(MYID)) + kv("h", p_bin(HASH))
+    g["get_req"] = outer([kv("a", abody), kv("q", p_str("get"))]
+                         + trailer(TID_BIN, "q"))
+
+    # get_values with a field-selection query {s:[Id], w:[]}
+    # (cpp:787-790; Query/Select value.h:744-812, Field::Id == 1)
+    q = p_map(2) + kv("s", p_array(1) + p_int(1)) + kv("w", p_array(0))
+    abody = (p_map(3) + kv("id", p_bin(MYID)) + kv("h", p_bin(HASH))
+             + kv("q", q))
+    g["get_req_select"] = outer([kv("a", abody), kv("q", p_str("get"))]
+                                + trailer(TID_BIN, "q"))
+
+    # listen request (cpp:1068-1100)
+    abody = (p_map(4) + kv("id", p_bin(MYID)) + kv("h", p_bin(HASH))
+             + kv("token", p_bin(TOKEN)) + kv("sid", p_bin(SID_BIN)))
+    g["listen_req"] = outer([kv("a", abody), kv("q", p_str("listen"))]
+                            + trailer(TID_BIN, "q"))
+
+    # announce (put) request, one inline value + created (cpp:1141-1175;
+    # packValueHeader cpp:889-911 inlines each serialized value into the
+    # "values" array)
+    abody = (p_map(5) + kv("id", p_bin(MYID)) + kv("h", p_bin(HASH))
+             + kv("values", p_array(1) + V1)
+             + kv("c", p_uint(CREATED)) + kv("token", p_bin(TOKEN)))
+    g["announce_req"] = outer([kv("a", abody), kv("q", p_str("put"))]
+                              + trailer(TID_BIN, "q"))
+
+    # refresh request (cpp:1200-1230)
+    abody = (p_map(4) + kv("id", p_bin(MYID)) + kv("h", p_bin(HASH))
+             + kv("vid", p_uint(VID)) + kv("token", p_bin(TOKEN)))
+    g["refresh_req"] = outer([kv("a", abody), kv("q", p_str("refresh"))]
+                             + trailer(TID_BIN, "q"))
+
+    # nodes+values response: n4, n6, token, two inline values
+    # (cpp:944-1000)
+    rbody = (p_map(6) + kv("id", p_bin(MYID)) + kv("sa", p_bin(SA4))
+             + kv("n4", p_bin(N4_BLOB)) + kv("n6", p_bin(N6_BLOB))
+             + kv("token", p_bin(TOKEN))
+             + kv("values", p_array(2) + V1 + V2))
+    g["nodes_values"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
+    # value announced response (cpp:1252-1262: id, vid, sa)
+    rbody = (p_map(3) + kv("id", p_bin(MYID)) + kv("vid", p_uint(VID))
+             + kv("sa", p_bin(SA4)))
+    g["value_announced"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
+    # error response with id (cpp:1267-1297: e, r, t, y, v)
+    e = p_array(2) + p_int(401) + p_str("Unauthorized")
+    rbody = p_map(1) + kv("id", p_bin(MYID))
+    g["error_unauthorized"] = outer(
+        [kv("e", e), kv("r", rbody)] + trailer(TID_BIN, "e"))
+
+    # value parts stream (sendValueParts cpp:913-941): per fragment
+    # map3 {y:"v", t, p:{<value index>: {o: offset, d: bin chunk}}},
+    # MTU=1280-byte chunks of the serialized value
+    blob = value_plain(77, 3, bytes(range(256)) * 11)   # > 2 MTUs long
+    parts = []
+    mtu, start, i = 1280, 0, 0
+    while start < len(blob):
+        end = min(start + mtu, len(blob))
+        frag = (p_map(1) + p_uint(i)
+                + (p_map(2) + kv("o", p_uint(start))
+                   + kv("d", p_bin(blob[start:end]))))
+        parts.append(outer([kv("y", p_str("v")), kv("t", p_bin(TID_BIN)),
+                            kv("p", frag)]))
+        start = end
+    g["value_parts"] = b"".join(parts)
+
+    return g
+
+
+def main() -> None:
+    for name, data in make_goldens().items():
+        path = os.path.join(HERE, name + ".bin")
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"{name}.bin: {len(data)} bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Minimal msgpack *encoder*, written directly from the msgpack spec.
+
+This is deliberately NOT python-msgpack (which the engine itself uses via
+utils.pack_msg): the golden wire fixtures must come from an independent
+second implementation so a shared encoding mistake can't validate itself.
+Only the encodings msgpack-c emits for the reference's pack calls are
+implemented, with the same smallest-width rules msgpack-c applies:
+
+  pk.pack(int)        → positive fixint / uint8 / uint16 / uint32 / uint64
+                        (negative: negative fixint / int8 / ...)
+  pk.pack(std::string)→ fixstr / str8 / str16
+  pk.pack_bin         → bin8 / bin16 / bin32
+  pk.pack_map(n)      → fixmap / map16
+  pk.pack_array(n)    → fixarray / array16
+  pk.pack(bool)       → 0xc2 / 0xc3
+
+spec: https://github.com/msgpack/msgpack/blob/master/spec.md
+"""
+
+import struct
+
+
+def p_uint(n: int) -> bytes:
+    if n < 0:
+        return p_int(n)
+    if n <= 0x7F:
+        return bytes([n])
+    if n <= 0xFF:
+        return b"\xcc" + bytes([n])
+    if n <= 0xFFFF:
+        return b"\xcd" + struct.pack(">H", n)
+    if n <= 0xFFFFFFFF:
+        return b"\xce" + struct.pack(">I", n)
+    return b"\xcf" + struct.pack(">Q", n)
+
+
+def p_int(n: int) -> bytes:
+    if n >= 0:
+        return p_uint(n)
+    if n >= -32:
+        return struct.pack(">b", n)
+    if n >= -128:
+        return b"\xd0" + struct.pack(">b", n)
+    if n >= -(1 << 15):
+        return b"\xd1" + struct.pack(">h", n)
+    if n >= -(1 << 31):
+        return b"\xd2" + struct.pack(">i", n)
+    return b"\xd3" + struct.pack(">q", n)
+
+
+def p_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) < 32:
+        return bytes([0xA0 | len(b)]) + b
+    if len(b) <= 0xFF:
+        return b"\xd9" + bytes([len(b)]) + b
+    return b"\xda" + struct.pack(">H", len(b)) + b
+
+
+def p_bin(b: bytes) -> bytes:
+    if len(b) <= 0xFF:
+        return b"\xc4" + bytes([len(b)]) + b
+    if len(b) <= 0xFFFF:
+        return b"\xc5" + struct.pack(">H", len(b)) + b
+    return b"\xc6" + struct.pack(">I", len(b)) + b
+
+
+def p_map(n: int) -> bytes:
+    """Map header only — caller appends n (key, value) encodings."""
+    if n < 16:
+        return bytes([0x80 | n])
+    return b"\xde" + struct.pack(">H", n)
+
+
+def p_array(n: int) -> bytes:
+    """Array header only — caller appends n element encodings."""
+    if n < 16:
+        return bytes([0x90 | n])
+    return b"\xdc" + struct.pack(">H", n)
+
+
+def p_bool(v: bool) -> bytes:
+    return b"\xc3" if v else b"\xc2"

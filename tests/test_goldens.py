@@ -1,0 +1,250 @@
+"""Frozen wire-format goldens: our engine's bytes vs an independent
+encoding of the reference protocol.
+
+The .bin fixtures under tests/goldens/ were produced by
+tests/goldens/make_goldens.py — a second msgpack implementation
+(mini_msgpack.py, written from the msgpack spec, NOT python-msgpack)
+transcribing the reference's pack calls (src/network_engine.cpp:677-1305,
+include/opendht/value.h:470-511).  If our NetworkEngine's emitted bytes
+drift from these files in any way — key order, int widths, bin headers,
+field sets — these tests fail.  The reverse direction parses each golden
+through ParsedMessage and checks full field recovery, i.e. we accept
+exactly what a reference peer would send.
+
+(The real C++ peer cannot be built here: cmake fails on missing
+GnuTLS/msgpack-c dev packages — see make_goldens.py docstring.)
+"""
+
+import glob
+import os
+
+import pytest
+
+from opendht_tpu.core.value import Field, Query, Select, Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.net.engine import EngineCallbacks, NetworkEngine
+from opendht_tpu.net.node import Node
+from opendht_tpu.net.parsed_message import MessageType, ParsedMessage
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+
+GOLDENS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
+
+MYID = bytes(range(20))
+TARGET = b"\xaa" * 20
+HASH = b"\xbb" * 20
+TID = 0x01020304
+SID = 0x05060709
+TOKEN = bytes(range(0x10, 0x18))
+CREATED = 1_700_000_000
+VID = 42
+ADDR = SockAddr("10.0.0.9", 4009)        # replies carry only the ip ("sa")
+N4_BLOB = (b"\xc1" * 20 + b"\x0a\x00\x00\x01" + (4000).to_bytes(2, "big")
+           + b"\xc2" * 20 + b"\x0a\x00\x00\x02" + (4001).to_bytes(2, "big"))
+N6_BLOB = (b"\xd1" * 20 + b"\x00" * 15 + b"\x01" + (4002).to_bytes(2, "big"))
+
+V1 = Value(b"hello world", type_id=3, value_id=VID)
+V2 = Value(b"second value", type_id=0, value_id=43, user_type="text/plain")
+
+
+def golden(name: str) -> bytes:
+    with open(os.path.join(GOLDENS, name + ".bin"), "rb") as f:
+        return f.read()
+
+
+def make_engine(network: int = 0):
+    sent = []
+    eng = NetworkEngine(InfoHash(MYID), network,
+                        lambda data, dst: sent.append(bytes(data)) or 0,
+                        Scheduler(), EngineCallbacks())
+    return eng, sent
+
+
+def fixed_node(*tids) -> Node:
+    node = Node(InfoHash.get("peer"), SockAddr("10.0.0.1", 4000))
+    seq = list(tids)
+    node.get_new_tid = lambda: seq.pop(0)
+    return node
+
+
+# ------------------------------------------------------------ emit == golden
+
+def test_ping_req():
+    eng, sent = make_engine()
+    eng.send_ping(fixed_node(TID))
+    assert sent[0] == golden("ping_req")
+
+
+def test_ping_req_network():
+    eng, sent = make_engine(network=7)
+    eng.send_ping(fixed_node(TID))
+    assert sent[0] == golden("ping_req_net7")
+
+
+def test_pong_and_listen_confirmation():
+    eng, sent = make_engine()
+    eng.send_pong(ADDR, TID)
+    eng.send_listen_confirmation(ADDR, TID)
+    assert sent[0] == golden("pong")
+    assert sent[1] == golden("pong")      # same layout (cpp:1119-1133)
+
+
+def test_find_req():
+    from opendht_tpu.utils import WANT4, WANT6
+    eng, sent = make_engine()
+    eng.send_find_node(fixed_node(TID), InfoHash(TARGET), want=WANT4 | WANT6)
+    assert sent[0] == golden("find_req")
+
+
+def test_get_req():
+    eng, sent = make_engine()
+    eng.send_get_values(fixed_node(TID), InfoHash(HASH), Query())
+    assert sent[0] == golden("get_req")
+
+
+def test_get_req_select():
+    eng, sent = make_engine()
+    q = Query(select=Select().field(Field.ID))
+    eng.send_get_values(fixed_node(TID), InfoHash(HASH), q)
+    assert sent[0] == golden("get_req_select")
+
+
+def test_listen_req():
+    eng, sent = make_engine()
+    node = fixed_node(SID, TID)
+    req = eng.send_listen(node, InfoHash(HASH), Query(), TOKEN, None,
+                          socket_cb=lambda *a: None)
+    assert req is not None
+    assert sent[0] == golden("listen_req")
+
+
+def test_announce_req():
+    eng, sent = make_engine()
+    eng.send_announce_value(fixed_node(TID), InfoHash(HASH), V1,
+                            float(CREATED), TOKEN)
+    assert sent[0] == golden("announce_req")
+
+
+def test_refresh_req():
+    eng, sent = make_engine()
+    eng.send_refresh_value(fixed_node(TID), InfoHash(HASH), VID, TOKEN)
+    assert sent[0] == golden("refresh_req")
+
+
+def test_nodes_values_resp():
+    eng, sent = make_engine()
+    eng.send_nodes_values(ADDR, TID, N4_BLOB, N6_BLOB, [V1, V2], Query(),
+                          TOKEN)
+    assert sent[0] == golden("nodes_values")
+
+
+def test_value_announced_resp():
+    eng, sent = make_engine()
+    eng.send_value_announced(ADDR, TID, VID)
+    assert sent[0] == golden("value_announced")
+
+
+def test_error_resp():
+    eng, sent = make_engine()
+    eng.send_error(ADDR, TID, 401, "Unauthorized", include_id=True)
+    assert sent[0] == golden("error_unauthorized")
+
+
+def test_value_parts_stream():
+    eng, sent = make_engine()
+    big = Value(bytes(range(256)) * 11, type_id=3, value_id=77)
+    eng._send_value_parts(TID, [big.get_packed()], ADDR)
+    assert b"".join(sent) == golden("value_parts")
+
+
+# ------------------------------------------------------- parse(golden) == ok
+
+def test_parse_ping():
+    m = ParsedMessage.from_bytes(golden("ping_req"))
+    assert m.type is MessageType.PING
+    assert bytes(m.id) == MYID and m.tid == TID and m.ua == "RNG1"
+
+
+def test_parse_find():
+    m = ParsedMessage.from_bytes(golden("find_req"))
+    assert m.type is MessageType.FIND_NODE
+    assert bytes(m.target) == TARGET
+    from opendht_tpu.utils import WANT4, WANT6
+    assert m.want == WANT4 | WANT6
+
+
+def test_parse_get_select():
+    m = ParsedMessage.from_bytes(golden("get_req_select"))
+    assert m.type is MessageType.GET_VALUES
+    assert bytes(m.info_hash) == HASH
+    assert m.query.select.get_selection() == [Field.ID]
+
+
+def test_parse_listen():
+    m = ParsedMessage.from_bytes(golden("listen_req"))
+    assert m.type is MessageType.LISTEN
+    assert m.token == TOKEN and m.socket_id == SID
+
+
+def test_parse_announce():
+    m = ParsedMessage.from_bytes(golden("announce_req"))
+    assert m.type is MessageType.ANNOUNCE_VALUE
+    assert m.token == TOKEN and m.created == CREATED
+    assert len(m.values) == 1
+    v = m.values[0]
+    assert v.id == VID and v.type == 3 and v.data == b"hello world"
+
+
+def test_parse_refresh():
+    m = ParsedMessage.from_bytes(golden("refresh_req"))
+    assert m.type is MessageType.REFRESH
+    assert m.value_id == VID and m.token == TOKEN
+
+
+def test_parse_nodes_values():
+    m = ParsedMessage.from_bytes(golden("nodes_values"))
+    assert m.nodes4_raw == N4_BLOB and m.nodes6_raw == N6_BLOB
+    assert m.token == TOKEN
+    assert [v.id for v in m.values] == [VID, 43]
+    assert m.values[1].user_type == "text/plain"
+    assert m.addr.ip is not None and m.addr.ip.packed == b"\x0a\x00\x00\x09"
+
+
+def test_parse_error():
+    m = ParsedMessage.from_bytes(golden("error_unauthorized"))
+    assert m.type is MessageType.ERROR
+    assert m.error_code == 401 and bytes(m.id) == MYID
+
+
+def test_parse_value_parts_reassembly():
+    """Feed the fragment stream through the engine's rx path after an
+    announce that declared part sizes (network_engine.cpp:407-457)."""
+    raw = golden("value_parts")
+    # split packets: each starts with 0x83 fixmap(3); reparse via Unpacker
+    from opendht_tpu.utils import unpack_stream
+    frags = [ParsedMessage.from_obj(o) for o in unpack_stream(raw)]
+    assert all(f.type is MessageType.VALUE_DATA for f in frags)
+    assert [f.tid for f in frags] == [TID] * len(frags)
+    blob = bytearray()
+    for f in frags:
+        for idx, part in f.value_parts.items():
+            assert idx == 0
+            off, data = part
+            assert off == len(blob)
+            blob.extend(data)
+    v = Value.from_packed(bytes(blob))
+    assert v.id == 77 and v.data == bytes(range(256)) * 11
+
+
+def test_goldens_regeneration_is_stable():
+    """make_goldens.py output matches the checked-in fixtures, so the
+    generator and the frozen bytes can't drift apart silently."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "make_goldens", os.path.join(GOLDENS, "make_goldens.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fresh = mod.make_goldens()
+    on_disk = {os.path.basename(p)[:-4]: open(p, "rb").read()
+               for p in glob.glob(os.path.join(GOLDENS, "*.bin"))}
+    assert fresh == on_disk
