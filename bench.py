@@ -69,15 +69,19 @@ def main():
 
     # the device path (and the axon tunnel in particular) warms up over
     # the first dispatches and throughput drifts in phases over minutes;
-    # warm thoroughly and take the best of a longer rep train
+    # warm thoroughly, run a longer rep train, and report the MEDIAN as
+    # the headline (reproducible run-to-run) with best alongside —
+    # round-1 reported best-of-10 and drifted ~15% vs the driver capture
     for _ in range(5):
         outs = run_all()           # compile + warm
-    rate = 0.0
-    for _ in range(10):
+    rates = []
+    for _ in range(16):
         t0 = time.perf_counter()
         outs = run_all()
         dt = time.perf_counter() - t0
-        rate = max(rate, Q / dt)
+        rates.append(Q / dt)
+    rate = float(np.median(rates))
+    best = max(rates)
 
     cert_frac = float(np.mean([np.asarray(c).mean() for _, _, c in outs]))
 
@@ -105,7 +109,8 @@ def main():
 
     print(json.dumps({
         "metric": f"batched findClosestNodes top-{K}, {Q} queries x {N} ids "
-                  f"({platform}); certified {cert_frac:.4f}, exact={exact}",
+                  f"({platform}); median of 16 (best {round(best, 1)}), "
+                  f"certified {cert_frac:.4f}, exact={exact}",
         "value": round(rate, 1),
         "unit": "lookups/s/chip",
         "vs_baseline": round(rate / scalar_rate, 2),

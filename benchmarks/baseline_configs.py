@@ -80,33 +80,71 @@ def config1() -> dict:
             if baseline else None}
 
 
-def config3() -> dict:
-    """α-parallel iterative lookups to k=8 convergence."""
+def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
+    """α-parallel iterative lookups to k=8 convergence.
+
+    The north-star shape is ``-Q 1000000`` against the 10M-node table
+    (BASELINE.json configs[2]): the query burst is streamed through the
+    device in fixed-shape waves (one compiled executable; search state
+    for one wave resident at a time) so HBM holds wave state + the
+    sorted table, never the full burst.  Reported latency is honest
+    FIFO-burst completion: every lookup in wave *i* completes when its
+    wave retires, so the p50 lookup latency is the retire time of the
+    wave holding the median lookup, measured from burst submission.
+    """
     import jax
     import jax.numpy as jnp
     from opendht_tpu.core.search import simulate_lookups
     from opendht_tpu.ops.sorted_table import sort_table
 
     on_accel = jax.devices()[0].platform != "cpu"
-    N = 10_000_000 if on_accel else 100_000
-    Q = 16_384 if on_accel else 1_024
+    N = N or (10_000_000 if on_accel else 100_000)
+    Q = Q or (16_384 if on_accel else 1_024)
+    chunk = min(Q, chunk or (131_072 if on_accel else 1_024))
     key = jax.random.PRNGKey(3)
     k1, k2 = jax.random.split(key)
     table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
     targets = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
     sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
+    del table
 
-    def run():
-        return simulate_lookups(sorted_ids, n_valid, targets,
-                                alpha=3, k=8)
+    n_waves = (Q + chunk - 1) // chunk
+    pad = n_waves * chunk - Q
+    if pad:
+        targets = jnp.concatenate([targets, targets[:pad]], axis=0)
+    waves = [targets[i * chunk:(i + 1) * chunk] for i in range(n_waves)]
 
-    out = run()                       # compile + results for stats
-    hops = np.asarray(out["hops"])
-    conv = float(np.asarray(out["converged"]).mean())
-    dt = _rates(lambda: tuple(run().values()), reps=3, warm=1)
+    def run_wave(t):
+        return simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8)
+
+    out = run_wave(waves[0])          # compile + stats for wave 0
+    hops_all = [np.asarray(out["hops"])]
+    conv_all = [np.asarray(out["converged"])]
+    for w in waves[1:]:               # stats pass (also warms caches)
+        o = run_wave(w)
+        hops_all.append(np.asarray(o["hops"]))
+        conv_all.append(np.asarray(o["converged"]))
+    hops = np.concatenate(hops_all)[:Q]
+    conv = float(np.concatenate(conv_all)[:Q].mean())
+
+    # timed pass: a sequential FIFO train over the full burst, recording
+    # per-wave retire times; best total of 2 trains (after 1 warm train)
+    def train():
+        t0 = time.perf_counter()
+        ends = []
+        for w in waves:
+            jax.block_until_ready(tuple(run_wave(w).values()))
+            ends.append(time.perf_counter() - t0)
+        return ends
+    train()
+    ends = min((train() for _ in range(2)), key=lambda e: e[-1])
+    dt = ends[-1]
+    p50_wave = min((Q // 2) // chunk, n_waves - 1)
     return {"metric": "config3 iterative search sim, alpha=3 k=8, "
-                      "%d lookups x %d nodes; p50 hops %d, converged %.3f"
-                      % (Q, N, int(np.percentile(hops, 50)), conv),
+                      "%d lookups x %d nodes, %d waves of %d; p50 hops %d, "
+                      "converged %.3f, p50 burst completion %.3fs"
+                      % (Q, N, n_waves, chunk,
+                         int(np.percentile(hops, 50)), conv, ends[p50_wave]),
             "value": round(Q / dt, 1), "unit": "lookups/s/chip",
             "vs_baseline": None}
 
@@ -197,10 +235,19 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="BASELINE.json config drivers")
     p.add_argument("-c", "--config", type=int, default=0,
                    help="config number (default: all)")
+    p.add_argument("-Q", type=int, default=0,
+                   help="config3: concurrent lookup count "
+                        "(north star: 1000000)")
+    p.add_argument("-N", type=int, default=0,
+                   help="config3: network size (default 10M on device)")
+    p.add_argument("--chunk", type=int, default=0,
+                   help="config3: lookups per device wave")
     args = p.parse_args(argv)
     todo = [args.config] if args.config else sorted(CONFIGS)
     for c in todo:
-        print(json.dumps(CONFIGS[c]()))
+        kw = ({"Q": args.Q, "N": args.N, "chunk": args.chunk}
+              if c == 3 else {})
+        print(json.dumps(CONFIGS[c](**kw)))
     return 0
 
 

@@ -652,6 +652,22 @@ class DhtRunner:
                    prio=True)
         return fut.result(10.0)
 
+    def get_node_message_stats(self, incoming: bool = False) -> list:
+        """[ping, find, get, listen, put] counters
+        (↔ DhtRunner::getNodeMessageStats, dhtrunner.cpp:317-321)."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._post(lambda dht: fut.set_result(
+            dht.engine.get_node_message_stats(incoming)
+            if hasattr(dht, "engine") else []), prio=True)
+        return fut.result(10.0)
+
+    def get_searches_log(self, af: int = 0) -> str:
+        """(↔ DhtRunner::getSearchesLog, dhtrunner.cpp:305-309)."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._post(lambda dht: fut.set_result(dht.get_searches_log(af)),
+                   prio=True)
+        return fut.result(10.0)
+
     def export_nodes(self) -> list:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._post_node(lambda dht: fut.set_result(dht.export_nodes()),

@@ -1,0 +1,153 @@
+"""Cluster-toolkit periphery: network_monitor, dhtcluster, scanner
+(analogs of reference python/tools/network_monitor.py, dhtcluster.py,
+scanner.py — live-UDP, small sizes)."""
+
+import io
+import json
+
+from opendht_tpu.testing.dhtcluster import ClusterShell, NodeCluster
+from opendht_tpu.testing.network_monitor import Monitor, main as monitor_main
+from opendht_tpu.testing.scanner import Scanner, offline_geo
+from opendht_tpu.runtime.config import NodeStatus
+from opendht_tpu.runtime.runner import DhtRunner
+
+
+def test_network_monitor_round():
+    mon = Monitor(None, num_ops=3, timeout=20.0)
+    try:
+        assert mon.wait_connected()
+        dt = mon.run_test()
+        assert dt < 20.0
+        dt2 = mon.run_test()         # second round reuses the listeners
+        assert dt2 < 20.0
+    finally:
+        mon.close()
+
+
+def test_network_monitor_cli():
+    assert monitor_main(["--local", "-n", "2", "--rounds", "1",
+                         "-t", "25", "-p", "0.1"]) == 0
+
+
+def test_dhtcluster_resize_and_stats():
+    net = NodeCluster()
+    try:
+        net.resize(3)
+        assert len(net.nodes) == 3
+        assert net.front() is net.nodes[0]
+        assert net.get(2) is net.nodes[2]
+        assert net.get(3) is None
+        stats = net.get_message_stats()
+        assert stats[0] == 3 and len(stats) == 6
+        net.resize(1)
+        assert len(net.nodes) == 1
+    finally:
+        net.close()
+    assert len(net.nodes) == 0
+
+
+def test_dhtcluster_shell():
+    net = NodeCluster()
+    net.resize(2)
+    out = io.StringIO()
+    shell = ClusterShell(net, stdout=out,
+                         stdin=io.StringIO(
+                             "ll\nnode 1\nll\nstats\nnode 99\n"
+                             "resize 1\nll\nnode\nll\nexit\n"))
+    shell.cmdloop()
+    text = out.getvalue()
+    assert "2 nodes running." in text
+    assert "Node " in text                       # selected node id
+    assert "Invalid node number: 99" in text
+    assert "1 nodes running." in text
+    assert shell.net is None and net.nodes == []  # closed by exit
+
+
+def test_scanner_crawls_local_network():
+    net = NodeCluster()
+    scan_node = DhtRunner()
+    try:
+        net.resize(4)
+        scan_node.run(0)
+        scan_node.bootstrap("127.0.0.1", net.front().get_bound_port())
+        import time
+        t0 = time.monotonic()
+        while (scan_node.get_status() is not NodeStatus.CONNECTED
+               and time.monotonic() - t0 < 30.0):
+            time.sleep(0.1)
+        sc = Scanner(scan_node)
+        sc.scan(timeout=60.0)
+        s = sc.summary()
+        json.dumps(s)                            # serializable
+        assert s["probes"] >= 1
+        assert s["nodes"] >= 3                   # found most of the net
+        assert s["geo"].get("loopback", 0) >= 1  # offline geo classifier
+        assert len(s["ring"]) == s["nodes"]
+        assert all(abs(p["x"] ** 2 + p["y"] ** 2 - 1) < 1e-6
+                   for p in s["ring"])
+    finally:
+        scan_node.join()
+        net.close()
+
+
+def test_http_server_roundtrip():
+    """POST form-encoded put, GET filtered json — the reference tool's
+    interface (python/tools/http_server.py:35-67)."""
+    import urllib.parse
+    import urllib.request
+
+    from opendht_tpu.testing.http_server import DhtHttpServer
+
+    a, b = DhtRunner(), DhtRunner()
+    srv = None
+    try:
+        a.run(0)
+        b.run(0)
+        b.bootstrap("127.0.0.1", a.get_bound_port())
+        import time
+        t0 = time.monotonic()
+        while (b.get_status() is not NodeStatus.CONNECTED
+               and time.monotonic() - t0 < 30.0):
+            time.sleep(0.1)
+        srv = DhtHttpServer(b, http_port=0)
+        base = "http://127.0.0.1:%d" % srv.port
+
+        body = urllib.parse.urlencode(
+            {"data": "hello http", "id": "77",
+             "user_type": "text/plain"}).encode()
+        with urllib.request.urlopen(base + "/some-key", data=body,
+                                    timeout=30) as r:
+            assert json.loads(r.read())["success"] is True
+
+        with urllib.request.urlopen(base + "/some-key", timeout=30) as r:
+            res = json.loads(r.read())
+        assert res.get("4d") == {"base64": "aGVsbG8gaHR0cA=="}
+
+        # WHERE filter on id: a non-matching id returns nothing
+        with urllib.request.urlopen(base + "/some-key?id=123",
+                                    timeout=30) as r:
+            assert json.loads(r.read()) == {}
+
+        # 40-hex path is used as a literal infohash
+        khex = "ab" * 20
+        with urllib.request.urlopen(
+                base + "/" + khex,
+                data=urllib.parse.urlencode({"base64": "AQID"}).encode(),
+                timeout=30) as r:
+            assert json.loads(r.read())["success"] is True
+        from opendht_tpu.infohash import InfoHash
+        vals = a.get_sync(InfoHash(bytes.fromhex(khex)), timeout=20.0)
+        assert any(v.data == b"\x01\x02\x03" for v in vals)
+    finally:
+        if srv is not None:
+            srv.stop()
+        a.join()
+        b.join()
+
+
+def test_offline_geo_classes():
+    assert offline_geo("127.0.0.1")["class"] == "loopback"
+    assert offline_geo("10.1.2.3")["class"] == "private"
+    assert offline_geo("8.8.8.8")["class"] == "global"
+    assert offline_geo("::1")["class"] == "loopback"
+    assert offline_geo("bogus")["class"] == "invalid"
