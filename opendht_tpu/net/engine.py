@@ -178,6 +178,7 @@ class NetworkEngine:
         self.in_stats = MessageStats()
         self.out_stats = MessageStats()
         self.blacklist: set[SockAddr] = set()
+        self.reply_via: Optional[Node] = None   # see deserialize_nodes
         # configurable ingress budget (the reference hardcodes 1600/s
         # global + 200/s per IP, network_engine.h:424,519-523)
         self.max_req_per_sec = max(int(max_req_per_sec), 8)
@@ -378,7 +379,7 @@ class NetworkEngine:
             # client filter only applies to confirm=1 query paths
             # (network_engine.cpp:496-528,570-572)
             self.cb.on_new_node(node, 2)
-            self.deserialize_nodes(msg, from_addr)
+            self.deserialize_nodes(msg, from_addr, via=node)
             rsocket.on_receive(node, msg)
             return
 
@@ -422,10 +423,10 @@ class NetworkEngine:
                 if req.type in (MessageType.ANNOUNCE_VALUE, MessageType.LISTEN):
                     node.auth_success()
                 req.reply_time = now
-                self.deserialize_nodes(msg, from_addr)
+                self.deserialize_nodes(msg, from_addr, via=node)
                 req.set_done(msg)
             else:
-                self.deserialize_nodes(msg, from_addr)
+                self.deserialize_nodes(msg, from_addr, via=node)
                 rsocket.on_receive(node, msg)
             return
 
@@ -471,33 +472,47 @@ class NetworkEngine:
             self.send_listen_confirmation(from_addr, msg.tid)
 
     # ------------------------------------------------- node (de)serialization
-    def deserialize_nodes(self, msg: ParsedMessage, from_addr: SockAddr) -> None:
+    def deserialize_nodes(self, msg: ParsedMessage, from_addr: SockAddr,
+                          via: Optional[Node] = None) -> None:
         """Unpack compact n4/n6 blobs into interned Nodes
-        (network_engine.cpp:851-887)."""
+        (network_engine.cpp:851-887).
+
+        ``via`` (the replying node) is exposed as ``self.reply_via`` for
+        the duration of the on_new_node callbacks, so the DHT core can
+        attribute discoveries to the reply that carried them (per-search
+        hop accounting, live_search.SearchNode.depth).  The engine is
+        single-threaded under the scheduler, so a context attribute is
+        race-free."""
         if (len(msg.nodes4_raw) % NODE4_INFO_BUF_LEN
                 or len(msg.nodes6_raw) % NODE6_INFO_BUF_LEN):
             raise DhtProtocolException(
                 DhtProtocolException.WRONG_NODE_INFO_BUF_LEN)
         now = self.scheduler.time()
-        for raw, step, fam, out in (
-                (msg.nodes4_raw, NODE4_INFO_BUF_LEN, _socket.AF_INET, msg.nodes4),
-                (msg.nodes6_raw, NODE6_INFO_BUF_LEN, _socket.AF_INET6, msg.nodes6)):
-            for off in range(0, len(raw), step):
-                ni = raw[off:off + step]
-                ni_id = InfoHash(ni[:20])
-                if ni_id == self.myid:
-                    continue
-                addr = SockAddr(ni[20:step - 2],
-                                int.from_bytes(ni[step - 2:step], "big"))
-                if addr.is_loopback() and from_addr.family == fam:
-                    # peer told us about a node on its own loopback:
-                    # reinterpret relative to the peer's address
-                    addr = SockAddr(from_addr.ip, addr.port)
-                if is_martian(addr) or self.is_blacklisted(addr):
-                    continue
-                n = self.cache.get_node(ni_id, addr, now, confirm=False)
-                out.append(n)
-                self.cb.on_new_node(n, 0)
+        self.reply_via = via
+        try:
+            for raw, step, fam, out in (
+                    (msg.nodes4_raw, NODE4_INFO_BUF_LEN, _socket.AF_INET,
+                     msg.nodes4),
+                    (msg.nodes6_raw, NODE6_INFO_BUF_LEN, _socket.AF_INET6,
+                     msg.nodes6)):
+                for off in range(0, len(raw), step):
+                    ni = raw[off:off + step]
+                    ni_id = InfoHash(ni[:20])
+                    if ni_id == self.myid:
+                        continue
+                    addr = SockAddr(ni[20:step - 2],
+                                    int.from_bytes(ni[step - 2:step], "big"))
+                    if addr.is_loopback() and from_addr.family == fam:
+                        # peer told us about a node on its own loopback:
+                        # reinterpret relative to the peer's address
+                        addr = SockAddr(from_addr.ip, addr.port)
+                    if is_martian(addr) or self.is_blacklisted(addr):
+                        continue
+                    n = self.cache.get_node(ni_id, addr, now, confirm=False)
+                    out.append(n)
+                    self.cb.on_new_node(n, 0)
+        finally:
+            self.reply_via = None
 
     def buffer_nodes(self, family: int, target: InfoHash, want: int,
                      nodes4: List[Node], nodes6: List[Node]

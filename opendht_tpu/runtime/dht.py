@@ -232,6 +232,15 @@ class Dht:
     def _searches_of(self, af: int) -> Dict[InfoHash, Search]:
         return self.searches.get(af, {})
 
+    def get_search_hops(self, key: InfoHash,
+                        af: int = _socket.AF_INET) -> Optional[int]:
+        """Protocol-level hops-to-converge of the search on ``key``: the
+        deepest discovery generation among the replied top-k candidates
+        (live_search.Search.current_hops).  Validated against the batched
+        simulator's hop counter in tests/test_hop_parity.py."""
+        sr = self._searches_of(af).get(key)
+        return sr.current_hops() if sr is not None else None
+
     def _try_search_insert(self, node: Node) -> bool:
         """Offer a newly-heard node to searches near its id, walking
         outward from its sorted position until a live search declines
@@ -241,12 +250,20 @@ class Dht:
         keys = self._search_keys.get(node.family)
         if not srs or keys is None:
             return False
+        # when this node arrived inside a reply, attribute its discovery
+        # generation per search: one deeper than the replying node's
+        # (hop accounting — live_search.SearchNode.depth)
+        via = self.engine.reply_via
         inserted = False
         pos = bisect_left(keys, bytes(node.id))
         for rng in (range(pos, len(keys)), range(pos - 1, -1, -1)):
             for i in rng:
                 sr = srs[InfoHash(keys[i])]
-                if sr.insert_node(node, now):
+                depth = None
+                if via is not None:
+                    vsn = sr.get_node(via)
+                    depth = (vsn.depth + 1) if vsn is not None else 1
+                if sr.insert_node(node, now, depth=depth):
                     inserted = True
                     self._edit_step(sr, now)
                 elif not sr.expired and not sr.done:
@@ -258,13 +275,21 @@ class Dht:
         table = self._table(node.family)
         if table is None:
             return
+        was_known = table.row_of(node.id) is not None
         row = table.insert(node.id, node.addr, self.scheduler.time(),
                            confirm=confirm)
         if row is not None and confirm == 0 \
                 and table._time_reply[row] == 0.0:
             # genuinely new hearsay node admitted into the table
             self._table_grow_time[node.family] = self.scheduler.time()
-        if row is not None or confirm:
+        # offer to searches whenever the node is NEW to us — even if its
+        # bucket was full and the table only cached it — or confirmed.
+        # The reference's RoutingTable::onNewNode returns true on the
+        # bucket-full path too (routing_table.cpp:254-261); gating on
+        # table admission starved searches of discovered nodes once
+        # buckets filled (found via the live-vs-simulator hop parity
+        # check, tests/test_hop_parity.py).
+        if not was_known or confirm:
             self._try_search_insert(node)
         if confirm:
             self._update_status(node.family)

@@ -110,10 +110,15 @@ class SearchNode:
 
     __slots__ = ("node", "probe_query", "pagination_queries", "get_status",
                  "listen_status", "acked", "token", "last_get_reply",
-                 "candidate", "sync_job")
+                 "candidate", "sync_job", "depth")
 
     def __init__(self, node: Node):
         self.node = node
+        # discovery generation within this search: 0 = seeded from the
+        # local table/bootstrap, d+1 = learned from a depth-d node's
+        # reply.  Drives the protocol-level hop metric (Search.
+        # current_hops) validated against core/search.py's simulator.
+        self.depth = 0
         self.probe_query: Optional[Query] = None
         # get query → sub-queries substituting it (pagination)
         self.pagination_queries: Dict[Query, List[Query]] = {}
@@ -292,10 +297,16 @@ class Search:
         self.op_expiration_job: Optional[Job] = None
 
     # -- candidate set ------------------------------------------------------
-    def insert_node(self, node: Node, now: float, token: bytes = b"") -> bool:
+    def insert_node(self, node: Node, now: float, token: bytes = b"",
+                    depth: Optional[int] = None) -> bool:
         """Sorted insert by XOR distance to target, trimming to
         SEARCH_NODES live candidates (src/search.h:636-722).  Returns True
-        if the node is new to this search."""
+        if the node is new to this search.
+
+        ``depth`` is the discovery generation (see SearchNode.depth):
+        None leaves an existing node untouched (new nodes default to 0);
+        a value applies min-rule so re-discovery through a shorter chain
+        lowers the recorded depth."""
         if node.family != self.af:
             return False
 
@@ -333,7 +344,10 @@ class Search:
                     return False
             if not self.nodes:
                 self.step_time = _NEVER
-            self.nodes.insert(idx, SearchNode(node))
+            sn_new = SearchNode(node)
+            if depth is not None:
+                sn_new.depth = depth
+            self.nodes.insert(idx, sn_new)
             new_node = True
             if node.expired:
                 if not self.expired:
@@ -346,6 +360,8 @@ class Search:
                     bad -= 1
                 self.nodes.pop()
 
+        if found and depth is not None and depth < self.nodes[idx].depth:
+            self.nodes[idx].depth = depth
         if token:
             sn = self.nodes[idx]
             sn.candidate = False
@@ -365,6 +381,18 @@ class Search:
 
     def get_nodes(self) -> List[Node]:
         return [sn.node for sn in self.nodes]
+
+    def current_hops(self, k: int = TARGET_NODES) -> Optional[int]:
+        """Protocol-level hop count: the deepest discovery generation
+        among the first k candidates that have replied, i.e. how many
+        sequential reply rounds separated the final converged set from
+        the seeds.  Comparable to core/search.py simulate_lookups'
+        ``hops`` output (its round counter equals this depth metric:
+        a node merged in round r carries generation r).  None until at
+        least one candidate replied."""
+        depths = [sn.depth for sn in self.nodes[:k]
+                  if sn.last_get_reply > _NEVER]
+        return max(depths) if depths else None
 
     def remove_expired_node(self, now: float) -> bool:
         """(src/search.h:539-551)"""

@@ -1,0 +1,105 @@
+"""Protocol-level hop validation: the live iterative search vs the
+batched simulator.
+
+Round 1's hop-parity test compared the batched engine against a scalar
+walker over the *same synthetic reply model* — validating the
+vectorization but not the model.  Here the simulator's hop prediction is
+checked against the real protocol path: cold-start lookups on a live
+virtual-UDP cluster (fresh observer node, empty table, one bootstrap
+seed — the same shape the simulator models), with per-search discovery
+generations tracked through actual SEND_NODES replies
+(live_search.SearchNode.depth).
+
+This validation caught two real defects when first run:
+
+1. Dht._on_new_node gated search insertion on routing-table admission,
+   so once buckets filled, nodes discovered in replies never reached the
+   searches — lookups "converged" in 1 hop onto stale sets with 0-2/8
+   recall of the true closest nodes.  (The reference offers every newly
+   heard node to searches even when its bucket is full,
+   routing_table.cpp:254-261.)
+2. The simulator's terminal reply model sampled the target neighborhood
+   uniformly instead of answering with the closest known set, inflating
+   predicted hops ~2x at small N.
+
+After both fixes: live recall is ~8/8 and live/simulated hop medians
+agree within ~1 at matched N (live p50 2-2.5 vs sim p50 3 at N=128 and
+N=512).
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from opendht_tpu import InfoHash
+from opendht_tpu.testing import VirtualNet
+
+
+def live_cold_start(n_nodes: int, n_lookups: int, seed: int = 7):
+    """Cold-start gets by fresh observers against an n_nodes virtual-UDP
+    network.  Returns (hops, recall) lists."""
+    import random
+    rng = random.Random(seed)
+    net = VirtualNet()
+    seed_node = net.add_node()
+    for _ in range(n_nodes - 1):
+        net.add_node()
+    net.bootstrap_all(seed_node)
+    assert net.run(240, net.all_connected), "cluster never converged"
+    # let table maintenance refresh liveness so replies reflect a
+    # converged network (stale tables degrade reply quality)
+    net.settle(60)
+    ids = [d.get_node_id() for d in net.nodes.values()]
+
+    hops, recall = [], []
+    for i in range(n_lookups):
+        obs = net.add_node()
+        net.bootstrap_node(obs, seed_node)
+        target = InfoHash(bytes(rng.getrandbits(8) for _ in range(20)))
+        done = {}
+        # issue the get IMMEDIATELY (no connectivity wait): the search
+        # must boot from the single seed like the simulator's cold-start
+        # model, not from a maintenance-warmed routing table
+        obs.get(target, lambda vals: True,
+                lambda ok, ns: done.update(ok=ok))
+        assert net.run(60, lambda: "ok" in done), "get never completed"
+        sr = obs._searches_of(socket.AF_INET).get(target)
+        h = sr.current_hops()
+        assert h is not None
+        hops.append(h)
+        true8 = {bytes(x) for x in
+                 sorted(ids, key=lambda n: bytes(target.xor(n)))[:8]}
+        found = {bytes(sn.node.id) for sn in sr.nodes[:8]}
+        recall.append(len(found & true8))
+        net.remove_node(obs)
+    return hops, recall
+
+
+def sim_hops(n_nodes: int, n_lookups: int, seed: int = 3):
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.core.search import simulate_lookups
+    from opendht_tpu.ops.sorted_table import sort_table
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    table = jax.random.bits(k1, (n_nodes, 5), dtype=jnp.uint32)
+    targets = jax.random.bits(k2, (n_lookups, 5), dtype=jnp.uint32)
+    sorted_ids, _perm, n_valid = sort_table(table)
+    out = simulate_lookups(sorted_ids, n_valid, targets)  # alpha=4, k=8
+    assert bool(np.asarray(out["converged"]).all())
+    return np.asarray(out["hops"]).tolist()
+
+
+@pytest.mark.parametrize("n_nodes", [128, 512])
+def test_live_vs_simulator_hop_parity(n_nodes):
+    live, recall = live_cold_start(n_nodes, n_lookups=8)
+    sim = sim_hops(n_nodes, n_lookups=512)
+    p50_live = float(np.median(live))
+    p50_sim = float(np.median(sim))
+    assert abs(p50_live - p50_sim) <= 1.5, \
+        f"live p50 {p50_live} (hops {live}) vs sim p50 {p50_sim}"
+    assert p50_live >= 1 and p50_sim >= 1
+    # the live lookups must actually find the global closest set — this
+    # is the assertion that exposed the _on_new_node admission bug
+    assert float(np.median(recall)) >= 7, (recall, live)
