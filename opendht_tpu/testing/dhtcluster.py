@@ -36,7 +36,6 @@ class NodeCluster:
     def __init__(self, port: int = 0):
         self.nodes: list[DhtRunner] = []
         self.port = port            # 0 = OS-assigned per node
-        self.node_uid = 0
 
     # -- lifecycle ---------------------------------------------------------
     def launch_node(self) -> DhtRunner:
@@ -45,7 +44,6 @@ class NodeCluster:
         if self.nodes:
             n.bootstrap("127.0.0.1", self.nodes[0].get_bound_port())
         self.nodes.append(n)
-        self.node_uid += 1
         return n
 
     def end_node(self) -> bool:
@@ -72,9 +70,9 @@ class NodeCluster:
     def get(self, i: int):
         return self.nodes[i] if 0 <= i < len(self.nodes) else None
 
-    def get_node_info_by_id(self, node_id=None):
+    def get_node_info_by_id(self, node_id):
         for n in self.nodes:
-            if node_id and n.get_node_id() == node_id:
+            if n.get_node_id() == node_id:
                 return n
         return None
 
@@ -141,6 +139,12 @@ class ClusterShell(cmd.Cmd):
             self.net.resize(int(arg))
         except Exception as e:
             self._print("Can't resize:", e)
+        # a shrink may have joined the selected node — deselect it so
+        # later commands don't act on a dead runner
+        if self.node is not None and self.node not in self.net.nodes:
+            self._print("(selected node %d was removed)" % self.node_num)
+            self.node, self.node_num = None, 0
+            self.prompt = ">> "
 
     def do_ll(self, arg):
         """Selected node id, or cluster size."""

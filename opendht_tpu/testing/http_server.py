@@ -66,10 +66,16 @@ def make_handler(node: DhtRunner):
             args = parse_qs(u.query)
             h = _key_of(uri)
             # build 'WHERE k=v,...' from whitelisted query params
-            # (http_server.py:38-41)
-            clauses = ",".join("%s=%s" % (k, v[0]) for k, v in args.items()
-                               if k in WHERE_FIELDS and v)
-            where = Where("WHERE " + clauses) if clauses else None
+            # (http_server.py:38-41); the reference's 'owner' param is
+            # the Where grammar's 'owner_pk'
+            clauses = ",".join(
+                "%s=%s" % ("owner_pk" if k == "owner" else k, v[0])
+                for k, v in args.items() if k in WHERE_FIELDS and v)
+            try:
+                where = Where("WHERE " + clauses) if clauses else None
+            except ValueError as e:
+                self._json({"error": str(e)}, code=400)
+                return
             values = node.get_sync(h, where=where) or []
             self._json({"%x" % v.id:
                         {"base64": base64.b64encode(v.data).decode()}

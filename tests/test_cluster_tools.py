@@ -52,13 +52,17 @@ def test_dhtcluster_shell():
     out = io.StringIO()
     shell = ClusterShell(net, stdout=out,
                          stdin=io.StringIO(
-                             "ll\nnode 1\nll\nstats\nnode 99\n"
-                             "resize 1\nll\nnode\nll\nexit\n"))
+                             "ll\nnode 2\nll\nstats\nnode 99\n"
+                             "resize 1\nls\nll\nnode\nll\nexit\n"))
     shell.cmdloop()
     text = out.getvalue()
     assert "2 nodes running." in text
     assert "Node " in text                       # selected node id
     assert "Invalid node number: 99" in text
+    # shrinking past the selected node deselects it instead of leaving a
+    # dead runner selected ('ls' right after must not crash/time out)
+    assert "(selected node 2 was removed)" in text
+    assert "No node selected." in text
     assert "1 nodes running." in text
     assert shell.net is None and net.nodes == []  # closed by exit
 
@@ -127,6 +131,21 @@ def test_http_server_roundtrip():
         with urllib.request.urlopen(base + "/some-key?id=123",
                                     timeout=30) as r:
             assert json.loads(r.read()) == {}
+
+        # 'owner' param maps onto the Where grammar's owner_pk; a
+        # malformed filter value returns a JSON 400, not a dropped
+        # connection
+        with urllib.request.urlopen(
+                base + "/some-key?owner=" + "cd" * 20, timeout=30) as r:
+            assert json.loads(r.read()) == {}
+        import urllib.error
+        try:
+            urllib.request.urlopen(base + "/some-key?id=not-a-number",
+                                   timeout=30)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
 
         # 40-hex path is used as a literal infohash
         khex = "ab" * 20
