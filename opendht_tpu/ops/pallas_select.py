@@ -105,14 +105,14 @@ def lex_topk_select(dist, invalid, *, k: int = 8, q_tile: int = 256,
 
     grid = (qp // q_tile,)
     in_spec = pl.BlockSpec((q_tile, W), lambda i: (i, 0),
-                           memory_space=pltpu.ANY
+                           memory_space=pl.ANY
                            if interpret else pltpu.VMEM)
     out = pl.pallas_call(
         functools.partial(_select_kernel, k=k),
         grid=grid,
         in_specs=[in_spec] * (N_LIMBS + 1),
         out_specs=pl.BlockSpec((q_tile, OUT_LANES), lambda i: (i, 0),
-                               memory_space=pltpu.ANY
+                               memory_space=pl.ANY
                                if interpret else pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((qp, OUT_LANES), jnp.int32),
         interpret=interpret,
