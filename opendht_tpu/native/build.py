@@ -82,14 +82,16 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dht_udp_create.restype = ctypes.c_void_p
     lib.dht_udp_create.argtypes = [ctypes.c_uint16, ctypes.c_uint32,
                                    ctypes.c_uint32, ctypes.c_uint32,
-                                   ctypes.c_int32]
+                                   ctypes.c_int32, ctypes.c_int32]
     lib.dht_udp_port.restype = ctypes.c_uint16
     lib.dht_udp_port.argtypes = [ctypes.c_void_p]
+    lib.dht_udp_has_v6.restype = ctypes.c_int32
+    lib.dht_udp_has_v6.argtypes = [ctypes.c_void_p]
     lib.dht_udp_destroy.restype = None
     lib.dht_udp_destroy.argtypes = [ctypes.c_void_p]
     lib.dht_udp_send.restype = ctypes.c_int
     lib.dht_udp_send.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32,
-                                 ctypes.c_uint32, ctypes.c_uint16]
+                                 u8p, ctypes.c_int32, ctypes.c_uint16]
     lib.dht_udp_poll.restype = ctypes.c_int32
     lib.dht_udp_poll.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
                                  ctypes.c_int32, u64p]

@@ -90,39 +90,50 @@ def scan_closest(ids, queries, k: int = 8) -> np.ndarray:
 
 
 class UdpEngine:
-    """Native datagram engine: C++ receiver thread + ring buffer +
-    ingress guards; Python drains packets in batches.
+    """Native dual-stack datagram engine: C++ receiver thread + ring
+    buffer + ingress guards over an IPv4 and (optionally) an IPv6-only
+    socket on the same port; Python drains packets in batches.
 
-    ↔ reference rcv_thread (dhtrunner.cpp:511-608) and NetworkEngine
-    ingress rate limits / martian filter (network_engine.h:424,
-    network_engine.cpp:339-401).
+    ↔ reference rcv_thread select loop over both sockets
+    (dhtrunner.cpp:511-608) and NetworkEngine ingress rate limits /
+    martian filter (network_engine.h:424, network_engine.cpp:339-401).
     """
 
-    _HDR = struct.Struct("<dIHH")
+    _HDR = struct.Struct("<dB16sHH")
 
     def __init__(self, port: int = 0, *, ring_size: int = 16384,
                  global_rps: int = 1600, per_ip_rps: int = 200,
-                 exempt_loopback: bool = True):
+                 exempt_loopback: bool = True, ipv6: bool = True):
         lib = _lib()
         self._lib = lib
         self._h = lib.dht_udp_create(port, ring_size, global_rps, per_ip_rps,
-                                     1 if exempt_loopback else 0)
+                                     1 if exempt_loopback else 0,
+                                     1 if ipv6 else 0)
         if not self._h:
             raise OSError("could not bind UDP port %d" % port)
         self._owned = True
         self.port = lib.dht_udp_port(self._h)
+        self.has_v6 = bool(lib.dht_udp_has_v6(self._h))
         self._buf = (ctypes.c_uint8 * (64 * 1024))()
         self._nbytes = ctypes.c_uint64(0)
 
     def send(self, data: bytes, addr: Tuple[str, int]) -> int:
-        ip = struct.unpack("!I", socket.inet_aton(addr[0]))[0]
+        host = addr[0]
+        if ":" in host:
+            packed = socket.inet_pton(socket.AF_INET6, host)
+            fam = 6
+        else:
+            packed = socket.inet_aton(host)
+            fam = 4
         return self._lib.dht_udp_send(self._h, _u8(data), len(data),
-                                      ip, addr[1])
+                                      _u8(packed.ljust(16, b"\0")), fam,
+                                      addr[1])
 
     def poll(self, max_pkts: int = 256
              ) -> List[Tuple[float, bytes, Tuple[str, int]]]:
         """Drain up to max_pkts received packets as
-        (rx_time, data, (ip, port)) tuples."""
+        (rx_time, data, (host, port)) tuples; host is a textual v4 or
+        v6 address."""
         out: List[Tuple[float, bytes, Tuple[str, int]]] = []
         while len(out) < max_pkts:
             n = self._lib.dht_udp_poll(
@@ -133,11 +144,14 @@ class UdpEngine:
             raw = bytes(self._buf[:self._nbytes.value])
             off = 0
             for _ in range(n):
-                rx_time, ip, port, ln = self._HDR.unpack_from(raw, off)
+                rx_time, fam, a16, port, ln = self._HDR.unpack_from(raw, off)
                 off += self._HDR.size
                 data = raw[off:off + ln]
                 off += ln
-                host = socket.inet_ntoa(struct.pack("!I", ip))
+                if fam == 6:
+                    host = socket.inet_ntop(socket.AF_INET6, a16)
+                else:
+                    host = socket.inet_ntoa(a16[:4])
                 out.append((rx_time, data, (host, port)))
         return out
 

@@ -128,8 +128,10 @@ class DhtRunner:
         dht_config = config.dht_config
         if config.identity and dht_config.node_id is None:
             dht_config.node_id = secure_node_id(config.identity[1])
+        has_v6 = ipv6 and (self._sock6 is not None
+                           or (self._udp is not None and self._udp.has_v6))
         dht = Dht(self._send, dht_config, Scheduler(),
-                  has_v4=True, has_v6=ipv6 and self._sock6 is not None)
+                  has_v4=True, has_v6=has_v6)
         self._dht = SecureDht(dht, config.identity)
         dht.status_cb = lambda s4, s6: None   # runner tracks status itself
         dht.warmup()     # compile hot kernels before serving any packet
@@ -145,11 +147,11 @@ class DhtRunner:
             self.enable_proxy(config.proxy_server)
 
     def _start_network(self, port: int, ipv6: bool) -> None:
-        """(↔ DhtRunner::startNetwork, dhtrunner.cpp:511-608).  IPv4 goes
-        through the native C++ datagram engine when available (recv
-        thread, ring buffer, martian filter and rate limits run in C++;
-        Python drains packet batches) and falls back to a Python socket
-        otherwise."""
+        """(↔ DhtRunner::startNetwork, dhtrunner.cpp:511-608).  Both
+        families go through the native C++ datagram engine when
+        available (recv thread polling the v4 + v6-only sockets, ring
+        buffer, martian filter and rate limits in C++; Python drains
+        packet batches) and fall back to Python sockets otherwise."""
         self._net_running = True
         if self._config.native_engine:
             try:
@@ -168,7 +170,8 @@ class DhtRunner:
                     self._udp = UdpEngine(
                         port, global_rps=budget * 16,
                         per_ip_rps=budget * 8,
-                        exempt_loopback=self._config.native_exempt_loopback)
+                        exempt_loopback=self._config.native_exempt_loopback,
+                        ipv6=ipv6)
                     self.bound_port = self._udp.port
                     self._native_thread = threading.Thread(
                         target=self._native_rcv_loop, name="dht-rcv-native",
@@ -181,7 +184,9 @@ class DhtRunner:
                                    _socket.SO_REUSEADDR, 1)
             self._sock4.bind(("0.0.0.0", port))
             self.bound_port = self._sock4.getsockname()[1]
-        if ipv6:
+        if ipv6 and not (self._udp is not None and self._udp.has_v6):
+            # v6 rides the native engine's second socket when available;
+            # this Python socket is the fallback path only
             try:
                 self._sock6 = _socket.socket(_socket.AF_INET6,
                                              _socket.SOCK_DGRAM)
@@ -199,7 +204,8 @@ class DhtRunner:
             self._native_thread.start()
 
     def _send(self, data: bytes, dest: SockAddr) -> int:
-        if dest.family != _socket.AF_INET6 and self._udp is not None:
+        if self._udp is not None and (dest.family != _socket.AF_INET6
+                                      or self._udp.has_v6):
             try:
                 return self._udp.send(data, dest.to_tuple())
             except OSError as e:

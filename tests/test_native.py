@@ -174,3 +174,39 @@ def test_helpers_raise_without_lib(monkeypatch):
         wrappers.common_bits(b"\0" * 20, b"\0" * 20)
     with pytest.raises(RuntimeError, match="native library unavailable"):
         wrappers.UdpEngine(0)
+
+
+def test_udp_v6_roundtrip():
+    with native.UdpEngine(0) as a, native.UdpEngine(0) as b:
+        if not (a.has_v6 and b.has_v6):
+            pytest.skip("no IPv6 on this host")
+        a.send(b"over six", ("::1", b.port))
+        deadline = time.monotonic() + 5.0
+        got = []
+        while not got and time.monotonic() < deadline:
+            got.extend(b.poll())
+            time.sleep(0.01)
+        assert got and got[0][1] == b"over six"
+        assert got[0][2] == ("::1", a.port)
+
+
+def test_udp_dual_stack_same_port():
+    with native.UdpEngine(0) as a, native.UdpEngine(0) as b:
+        if not b.has_v6:
+            pytest.skip("no IPv6 on this host")
+        a.send(b"via four", ("127.0.0.1", b.port))
+        a.send(b"via six", ("::1", b.port))
+        deadline = time.monotonic() + 5.0
+        got = []
+        while len(got) < 2 and time.monotonic() < deadline:
+            got.extend(b.poll())
+            time.sleep(0.01)
+        assert {p[1] for p in got} == {b"via four", b"via six"}
+        fams = {(":" in p[2][0]) for p in got}
+        assert fams == {True, False}
+
+
+def test_udp_v6_disabled():
+    with native.UdpEngine(0, ipv6=False) as e:
+        assert not e.has_v6
+        assert e.send(b"x", ("::1", 1)) != 0     # EAFNOSUPPORT

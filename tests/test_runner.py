@@ -41,7 +41,10 @@ def test_ipv6_dual_stack_put_get():
     a, b = DhtRunner(), DhtRunner()
     a.run(0, ipv6=True)
     b.run(0, ipv6=True)
-    if a._sock6 is None or b._sock6 is None:
+    def v6_up(r):
+        return (r._sock6 is not None
+                or (r._udp is not None and r._udp.has_v6))
+    if not (v6_up(a) and v6_up(b)):
         a.join(); b.join()
         pytest.skip("no IPv6 loopback available")
     try:
