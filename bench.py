@@ -1,14 +1,26 @@
 """Headline benchmark — batched findClosestNodes on one chip.
 
 BASELINE.json config 2: Q InfoHash queries × N node ids → exact top-16
-XOR-closest, via the sorted-table window kernel
-(opendht_tpu/ops/sorted_table.py).  The baseline is the reference's
-scalar algorithm — walk a lexicographically sorted map outward from
-lower_bound picking the XOR-closer side each step
-(NodeCache::getCachedNodes, /root/reference/src/node_cache.cpp:41-74) —
+XOR-closest, via the expanded-table row-gather lookup
+(opendht_tpu/ops/sorted_table.py: expand_table + expanded_topk).  The
+baseline is the reference's scalar algorithm — walk a lexicographically
+sorted map outward from lower_bound picking the XOR-closer side each
+step (NodeCache::getCachedNodes, /root/reference/src/node_cache.cpp:41-74) —
 timed in-process on the host CPU over the same table.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Timing methodology (honest-by-construction): the per-batch time is the
+*slope* of a device-serialized rep chain — one jitted program runs the
+full lookup R times in a lax.while_loop whose trip count is a traced
+scalar (one executable serves every R; the dynamic bound rules out
+unrolling and cross-rep CSE), each rep's queries perturbed by the
+loop index so XLA cannot elide or overlap reps, and the per-batch time
+is (t[R2] - t[R1]) / (R2 - R1).  This cancels every constant cost
+(dispatch, tunnel round-trip, completion-poll quantum) and counts only
+real device execution.  Earlier rounds timed pipelined dispatches and
+trusted block_until_ready(), which on a tunneled device returns before
+execution completes — that inflated throughput up to ~100×
+(BENCH_r01.json's 127M lookups/s/chip was such an artifact; the honest
+figure for that same kernel is ~1M).
 """
 
 import bisect
@@ -18,12 +30,13 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from opendht_tpu.ops.sorted_table import sort_table, window_topk
+from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                          expand_table, expanded_topk)
 from opendht_tpu.ops.xor_topk import xor_topk
 
 K = 16
-WINDOW = 256
 
 
 def scalar_closest(sorted_ints, q, k):
@@ -45,12 +58,66 @@ def scalar_closest(sorted_ints, q, k):
     return out
 
 
-def main():
+def best_of(fn, tries: int = 3):
+    """Best wall-clock of ``tries`` calls to ``fn()`` — only valid for
+    host-side work (the native baseline) or already-slope-timed chains;
+    never for timing raw device dispatches (see module docstring)."""
+    best = None
+    for _ in range(tries):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
+                tries: int = 3):
+    """Per-rep device time of ``body`` via the serialized-chain slope:
+    jit a dynamic-trip-count rep loop and return
+    (t[r2] - t[r1]) / (r2 - r1).  Cancels dispatch, tunnel round-trip,
+    and completion-poll constants — see module docstring.
+
+    ``body(x, *consts) -> f32 scalar`` must consume its result into the
+    returned scalar; ``example`` is the input batch (uint32 limbs).  The
+    input is XORed with the full rep index here, so every rep is a
+    distinct computation XLA cannot elide or CSE.
+
+    Pass every large array the body reads (tables, LUTs, …) through
+    ``consts`` — closing over a concrete jax.Array embeds it as an HLO
+    *constant*, and the remote-compile tunnel then serializes the whole
+    table into the compile request (measured: a closed-over 480 MB
+    expanded table pushed one compile past 20 minutes; as an argument
+    it adds nothing).
+    """
+    @jax.jit
+    def g(x, reps, *a):
+        def cond(c):
+            return c[0] < reps
+        def step(c):
+            i, acc = c
+            return i + 1, acc + body(x ^ i.astype(x.dtype), *a)
+        # while_loop with a *traced* trip count: one executable serves
+        # every rep count (the second compile would otherwise dominate
+        # multi-minute workloads on the remote-compile tunnel), and the
+        # dynamic bound forbids unrolling/CSE across reps by construction
+        return lax.while_loop(cond, step,
+                              (jnp.int32(0), jnp.zeros((), jnp.float32)))[1]
+
+    float(g(example, jnp.int32(r2), *consts))     # compile + warm
+    def timed(reps):
+        return best_of(lambda: float(g(example, jnp.int32(reps), *consts)),
+                       tries)
+
+    return (timed(r2) - timed(r1)) / (r2 - r1)
+
+
+def measure() -> dict:
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     N = 1_000_000 if on_accel else 100_000
     Q = 131_072 if on_accel else 8_192
-    CHUNK = 16_384 if on_accel else 4_096
+    lut_bits = 20 if N >= (1 << 18) else 16
 
     key = jax.random.PRNGKey(0)
     k1, k2 = jax.random.split(key)
@@ -58,38 +125,27 @@ def main():
     queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
 
     sorted_ids, perm, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(
+        build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
+    expanded = jax.block_until_ready(expand_table(sorted_ids))
 
-    def run_all():
-        outs = []
-        for s in range(0, Q, CHUNK):
-            d, idx, cert = window_topk(sorted_ids, n_valid,
-                                       queries[s:s + CHUNK], k=K, window=WINDOW)
-            outs.append((d, idx, cert))
-        return jax.block_until_ready(outs)
+    def lookup(q, sorted_ids, expanded, n_valid, lut):
+        d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
+                                  lut=lut)
+        return jnp.sum(c.astype(jnp.float32))
 
-    # the device path (and the axon tunnel in particular) warms up over
-    # the first dispatches and throughput drifts in phases over minutes;
-    # warm thoroughly, run a longer rep train, and report the MEDIAN as
-    # the headline (reproducible run-to-run) with best alongside —
-    # round-1 reported best-of-10 and drifted ~15% vs the driver capture
-    for _ in range(5):
-        outs = run_all()           # compile + warm
-    rates = []
-    for _ in range(16):
-        t0 = time.perf_counter()
-        outs = run_all()
-        dt = time.perf_counter() - t0
-        rates.append(Q / dt)
-    rate = float(np.median(rates))
-    best = max(rates)
+    per_batch = chain_slope(lookup, queries, sorted_ids, expanded, n_valid,
+                            lut)
+    rate = Q / per_batch
 
-    cert_frac = float(np.mean([np.asarray(c).mean() for _, _, c in outs]))
-
-    # exactness spot-check vs the full-scan oracle
+    # exactness + certificate fraction vs the full-scan oracle
+    d, i, cert = jax.block_until_ready(
+        expanded_topk(sorted_ids, expanded, n_valid, queries, k=K, lut=lut))
+    cert_frac = float(np.asarray(cert).mean())
     d_ref, i_ref = xor_topk(queries[:256], sorted_ids, k=K,
                             valid=jnp.arange(N) < n_valid)
-    d_win = outs[0][0][:256]
-    exact = bool(np.array_equal(np.asarray(d_win), np.asarray(d_ref)))
+    exact = bool(np.array_equal(np.asarray(d[:256]), np.asarray(d_ref))
+                 and np.array_equal(np.asarray(i[:256]), np.asarray(i_ref)))
 
     # scalar CPU baseline on the same sorted table
     def pack160(rows):
@@ -107,14 +163,19 @@ def main():
         scalar_closest(sorted_ints, q, K)
     scalar_rate = len(q_ints) / (time.perf_counter() - t0)
 
-    print(json.dumps({
+    return {
         "metric": f"batched findClosestNodes top-{K}, {Q} queries x {N} ids "
-                  f"({platform}); median of 16 (best {round(best, 1)}), "
-                  f"certified {cert_frac:.4f}, exact={exact}",
+                  f"({platform}); device-serialized chain slope, "
+                  f"{per_batch * 1e3:.1f} ms/batch, certified "
+                  f"{cert_frac:.4f}, exact={exact}",
         "value": round(rate, 1),
         "unit": "lookups/s/chip",
         "vs_baseline": round(rate / scalar_rate, 2),
-    }))
+    }
+
+
+def main():
+    print(json.dumps(measure()))
 
 
 if __name__ == "__main__":

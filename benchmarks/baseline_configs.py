@@ -12,7 +12,7 @@ Configs (BASELINE.json):
     table — CPU reference (the native C++ sorted walk) vs the device
     batched lookup.
   2 batched findClosestNodes: 131K queries × 1M ids, top-16 (the
-    headline bench, see bench.py).
+    headline bench — delegates to bench.py's measurement).
   3 iterative Search simulation: α-parallel lookups vs a 10M-node
     simulated network, k=8 convergence, hop counts.
   4 bucket-refresh sweep: full radix partition + per-bucket stats over
@@ -20,6 +20,13 @@ Configs (BASELINE.json):
   5 multi-chip sharded table: row-sharded lookup with ICI top-k merge
     (one real chip here; the same code dry-runs on an 8-device virtual
     mesh — __graft_entry__.dryrun_multichip).
+
+Timing: all device numbers use the serialized-chain slope
+(bench.chain_slope) — a jitted while_loop (traced trip count) repeats
+the workload with index-perturbed inputs and the per-rep time is the slope between two
+rep counts.  Wall-clock timing of dispatched work is NOT trusted:
+block_until_ready() on a tunneled device can return before execution
+completes (see bench.py docstring; it inflated round-1 numbers ~100×).
 """
 
 from __future__ import annotations
@@ -28,24 +35,10 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def _rates(fn, reps: int = 5, warm: int = 2):
-    import jax
-    for _ in range(warm):
-        jax.block_until_ready(fn())
-    best = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    return best
 
 
 def config1() -> dict:
@@ -53,8 +46,10 @@ def config1() -> dict:
     (the CPU reference) vs the batched device kernel."""
     import jax
     import jax.numpy as jnp
+    from bench import chain_slope
     from opendht_tpu.ops.ids import ids_to_bytes
-    from opendht_tpu.ops.sorted_table import sort_table, window_topk
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              expand_table, expanded_topk)
     from opendht_tpu import native
 
     N, Q, K = 10_000, 1_000, 8
@@ -64,17 +59,30 @@ def config1() -> dict:
 
     sorted_ids, perm, n_valid = jax.block_until_ready(
         sort_table(jnp.asarray(table)))
-    dt_dev = _rates(lambda: window_topk(sorted_ids, n_valid,
-                                        jnp.asarray(queries), k=K))
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    expanded = expand_table(sorted_ids)
+
+    def body(q, sorted_ids, expanded, n_valid, lut):
+        d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
+                                  lut=lut)
+        return jnp.sum(c.astype(jnp.float32))
+
+    # per-rep work is ~0.2 ms at this size: use deep rep counts so the
+    # slope rises above run-to-run noise (single compile either way —
+    # the trip count is traced)
+    dt_dev = chain_slope(body, jnp.asarray(queries), sorted_ids, expanded,
+                         n_valid, lut, r1=64, r2=512)
 
     baseline = None
     if native.available():
         t_bytes = ids_to_bytes(np.asarray(sorted_ids)).reshape(N, 20)
         q_bytes = ids_to_bytes(queries).reshape(Q, 20)
-        # same warm + best-of-N treatment as the device path
-        baseline = _rates(
-            lambda: native.sorted_closest(t_bytes, q_bytes, k=K))
-    return {"metric": "config1 1K get() over 10K-node table",
+        # native path runs on the host CPU: plain wall timing is honest
+        from bench import best_of
+        baseline = best_of(
+            lambda: native.sorted_closest(t_bytes, q_bytes, k=K), tries=7)
+    return {"metric": "config1 1K get() over 10K-node table "
+                      "(device-serialized chain slope)",
             "value": round(Q / dt_dev, 1), "unit": "lookups/s",
             "vs_baseline": round((Q / dt_dev) / (Q / baseline), 2)
             if baseline else None}
@@ -87,13 +95,16 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
     (BASELINE.json configs[2]): the query burst is streamed through the
     device in fixed-shape waves (one compiled executable; search state
     for one wave resident at a time) so HBM holds wave state + the
-    sorted table, never the full burst.  Reported latency is honest
-    FIFO-burst completion: every lookup in wave *i* completes when its
-    wave retires, so the p50 lookup latency is the retire time of the
-    wave holding the median lookup, measured from burst submission.
+    sorted table, never the full burst.
+
+    Throughput is the chain slope of one wave (device-serialized), and
+    burst numbers derive from it: burst time = n_waves × wave time.
+    The separately-reported ``p50 burst completion`` is wave-time ×
+    (wave index holding the median lookup + 1) — FIFO retire order.
     """
     import jax
     import jax.numpy as jnp
+    from bench import chain_slope
     from opendht_tpu.core.search import simulate_lookups
     from opendht_tpu.ops.sorted_table import sort_table
 
@@ -114,37 +125,34 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
         targets = jnp.concatenate([targets, targets[:pad]], axis=0)
     waves = [targets[i * chunk:(i + 1) * chunk] for i in range(n_waves)]
 
-    def run_wave(t):
+    def run_wave(t, sorted_ids=sorted_ids, n_valid=n_valid):
         return simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8)
 
-    out = run_wave(waves[0])          # compile + stats for wave 0
-    hops_all = [np.asarray(out["hops"])]
-    conv_all = [np.asarray(out["converged"])]
-    for w in waves[1:]:               # stats pass (also warms caches)
+    # stats pass over the full burst (hops / convergence are exact)
+    hops_all, conv_all = [], []
+    for w in waves:
         o = run_wave(w)
         hops_all.append(np.asarray(o["hops"]))
         conv_all.append(np.asarray(o["converged"]))
     hops = np.concatenate(hops_all)[:Q]
     conv = float(np.concatenate(conv_all)[:Q].mean())
 
-    # timed pass: a sequential FIFO train over the full burst, recording
-    # per-wave retire times; best total of 2 trains (after 1 warm train)
-    def train():
-        t0 = time.perf_counter()
-        ends = []
-        for w in waves:
-            jax.block_until_ready(tuple(run_wave(w).values()))
-            ends.append(time.perf_counter() - t0)
-        return ends
-    train()
-    ends = min((train() for _ in range(2)), key=lambda e: e[-1])
-    dt = ends[-1]
+    # timed pass: serialized-chain slope of one wave
+    def body(t, sorted_ids, n_valid):
+        o = run_wave(t, sorted_ids, n_valid)
+        return (jnp.sum(o["hops"].astype(jnp.float32))
+                + jnp.sum(o["converged"].astype(jnp.float32)))
+
+    wave_dt = chain_slope(body, waves[0], sorted_ids, n_valid, r1=1, r2=4)
+    dt = wave_dt * n_waves
     p50_wave = min((Q // 2) // chunk, n_waves - 1)
     return {"metric": "config3 iterative search sim, alpha=3 k=8, "
                       "%d lookups x %d nodes, %d waves of %d; p50 hops %d, "
-                      "converged %.3f, p50 burst completion %.3fs"
+                      "converged %.3f, p50 burst completion %.3fs "
+                      "(wave chain slope %.3fs)"
                       % (Q, N, n_waves, chunk,
-                         int(np.percentile(hops, 50)), conv, ends[p50_wave]),
+                         int(np.percentile(hops, 50)), conv,
+                         wave_dt * (p50_wave + 1), wave_dt),
             "value": round(Q / dt, 1), "unit": "lookups/s/chip",
             "vs_baseline": None}
 
@@ -153,6 +161,7 @@ def config4() -> dict:
     """Bucket-refresh sweep: radix partition + per-bucket stats."""
     import jax
     import jax.numpy as jnp
+    from bench import chain_slope
     from opendht_tpu.ops import radix
 
     on_accel = jax.devices()[0].platform != "cpu"
@@ -163,14 +172,17 @@ def config4() -> dict:
     valid = jnp.ones((N,), bool)
     last = jnp.zeros((N,), jnp.float32)
 
-    def run():
-        b = radix.bucket_of(self_id, ids)
-        c = radix.bucket_counts(self_id, ids, valid)
-        s = radix.bucket_last_seen(self_id, ids, valid, last)
-        return b, c, s
+    def body(x, self_id, valid, last):
+        b = radix.bucket_of(self_id, x)
+        c = radix.bucket_counts(self_id, x, valid)
+        s = radix.bucket_last_seen(self_id, x, valid, last)
+        return (jnp.sum(b.astype(jnp.float32)) * 1e-9
+                + jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(s) * 1e-9)
 
-    dt = _rates(run)
-    return {"metric": "config4 radix bucket sweep over %d ids" % N,
+    dt = chain_slope(body, ids, self_id, valid, last, r1=1, r2=4)
+    return {"metric": "config4 radix bucket sweep over %d ids "
+                      "(device-serialized chain slope)" % N,
             "value": round(N / dt, 1), "unit": "ids/s/chip",
             "vs_baseline": None}
 
@@ -180,7 +192,10 @@ def config5() -> dict:
     devices; multi-chip validated by dryrun_multichip)."""
     import jax
     import jax.numpy as jnp
-    from opendht_tpu.parallel import make_mesh, sharded_lookup
+    from bench import chain_slope
+    from opendht_tpu.parallel import (make_mesh, sharded_sort_table,
+                                      sharded_expand_table,
+                                      sharded_window_lookup)
 
     n_dev = len(jax.devices())
     on_accel = jax.devices()[0].platform != "cpu"
@@ -191,41 +206,32 @@ def config5() -> dict:
     queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
     mesh = make_mesh(n_dev)
 
-    def run():
-        return sharded_lookup(mesh, queries, table, k=8)
+    sorted_ids, perm, n_valid = jax.block_until_ready(
+        sharded_sort_table(mesh, table))
+    expanded, lut = jax.block_until_ready(
+        sharded_expand_table(mesh, sorted_ids, n_valid,
+                             bits=20 if on_accel else 16))
 
-    dt = _rates(run, reps=3, warm=2)
+    def body(q, sorted_ids, perm, n_valid, expanded, lut):
+        d, idx = sharded_window_lookup(mesh, q, sorted_ids, perm, n_valid,
+                                       k=8, expanded=expanded, lut=lut)
+        return jnp.sum((idx >= 0).astype(jnp.float32))
+
+    dt = chain_slope(body, queries, sorted_ids, perm, n_valid, expanded, lut,
+                     r1=1, r2=3)
     return {"metric": "config5 sharded lookup, %d devices, "
-                      "%d queries x %d ids" % (n_dev, Q, N),
+                      "%d queries x %d ids "
+                      "(device-serialized chain slope)" % (n_dev, Q, N),
             "value": round(Q / dt, 1), "unit": "lookups/s",
             "vs_baseline": None}
 
 
 def config2() -> dict:
-    """Delegates to the headline bench (bench.py) parameters."""
-    import jax
-    import jax.numpy as jnp
-    from opendht_tpu.ops.sorted_table import sort_table, window_topk
-
-    on_accel = jax.devices()[0].platform != "cpu"
-    N = 1_000_000 if on_accel else 100_000
-    Q = 131_072 if on_accel else 8_192
-    CHUNK = 16_384 if on_accel else 4_096
-    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
-    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
-    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
-    sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
-
-    def run():
-        return [window_topk(sorted_ids, n_valid, queries[s:s + CHUNK],
-                            k=16, window=256)
-                for s in range(0, Q, CHUNK)]
-
-    dt = _rates(run, reps=5, warm=3)
-    return {"metric": "config2 batched findClosestNodes top-16, "
-                      "%d queries x %d ids" % (Q, N),
-            "value": round(Q / dt, 1), "unit": "lookups/s/chip",
-            "vs_baseline": None}
+    """Delegates to the headline bench (bench.py)."""
+    from bench import measure
+    out = measure()
+    out["metric"] = "config2 " + out["metric"]
+    return out
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}

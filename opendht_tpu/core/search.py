@@ -17,7 +17,8 @@ steps instead of millions of scalar iterations.
 State layout (fixed shapes; "no candidate" = node index -1):
 
     cand_node [Q, S]     int32   sorted-table index of each candidate
-    cand_dist [Q, S, 5]  uint32  XOR distance to the target (sort key)
+    cand_l    5×[Q, S]   uint32  XOR distance limb planes (sort key;
+                                 kept planar — see layout note below)
     queried   [Q, S]     int32   request sent
     replied   [Q, S]     int32   reply merged
     hops      [Q]        int32   rounds taken until convergence
@@ -46,9 +47,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.ids import N_LIMBS, ID_BITS, xor_ids, common_bits, ids_to_bytes
+from ..ops.ids import N_LIMBS, ID_BITS, ids_to_bytes, clz32
 from ..ops.radix import _PREFIX_MASKS
-from ..ops.sorted_table import _lower_bound
+from ..ops.sorted_table import _lower_bound, build_prefix_lut
 
 _U32 = jnp.uint32
 
@@ -79,7 +80,7 @@ def _increment(ids):
     return jnp.stack(out[::-1], axis=-1)
 
 
-def _prefix_block_bounds(sorted_ids, n, targets, prefix_len):
+def _prefix_block_bounds(sorted_ids, n, targets, prefix_len, lut=None):
     """[lo, ub) sorted-index range of ids sharing `prefix_len` leading bits
     with each target.  targets [..., 5]; prefix_len [...] int32."""
     masks = jnp.take(jnp.asarray(_PREFIX_MASKS),
@@ -88,8 +89,10 @@ def _prefix_block_bounds(sorted_ids, n, targets, prefix_len):
     p_hi = p_lo | ~masks
     flat_lo = p_lo.reshape(-1, N_LIMBS)
     flat_hi = _increment(p_hi).reshape(-1, N_LIMBS)
-    lo = _lower_bound(sorted_ids, flat_lo, n).reshape(targets.shape[:-1])
-    ub = _lower_bound(sorted_ids, flat_hi, n).reshape(targets.shape[:-1])
+    lo = _lower_bound(sorted_ids, flat_lo, n, lut=lut,
+                      lut_steps=None).reshape(targets.shape[:-1])
+    ub = _lower_bound(sorted_ids, flat_hi, n, lut=lut,
+                      lut_steps=None).reshape(targets.shape[:-1])
     # p_hi of all-ones wraps to zero on increment → block extends to n
     wrapped = jnp.all(_increment(p_hi) == 0, axis=-1)
     ub = jnp.where(wrapped, n, ub)
@@ -124,16 +127,50 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     n = jnp.asarray(n_valid, jnp.int32)
     seed_u = jnp.asarray(seed, dtype=jnp.int32).astype(_U32)
 
-    pos_t = _lower_bound(sorted_ids, targets, n)      # [Q], for fallback replies
+    # Layout note (measured on v5e): any [.., .., 5] intermediate pads
+    # its 5-lane minor dim to 128 in TPU tiled layout (25× physical
+    # traffic — ~2.7 GB per materialized [Q, S+R, 5] at Q=131072), and
+    # per-element row gathers run issue-bound at ~190K rows/ms.  So the
+    # loop state keeps distances as 5 separate [Q, S] limb planes, id
+    # gathers go through the transposed [5, N] table (planar output,
+    # no lane padding), and the positioning searches use the prefix LUT
+    # (exact for any non-adversarial table: the in-bucket depth covers
+    # 64× the expected bucket size; the model stays deterministic
+    # either way).
+    sorted_t = sorted_ids.T                            # [5, N] one transpose
+    lut = build_prefix_lut(sorted_ids, n,
+                           bits=20 if N >= (1 << 18) else 16)
+
+    def gather_planar(rows):
+        """rows [...] int32 → list of 5 limb arrays shaped like rows."""
+        cl = jnp.clip(rows, 0, N - 1).reshape(-1)
+        g = jnp.take(sorted_t, cl, axis=1)             # [5, M]
+        return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
+
+    def common_bits_planar(a_l, b_l):
+        """commonBits over limb-plane lists (same math as ids.common_bits)."""
+        out = jnp.full(a_l[0].shape, ID_BITS, dtype=jnp.int32)
+        prev_zero = jnp.ones(a_l[0].shape, dtype=bool)
+        for i in range(N_LIMBS):
+            xi = a_l[i] ^ b_l[i]
+            is_first = prev_zero & (xi != 0)
+            out = jnp.where(is_first, 32 * i + clz32(xi), out)
+            prev_zero = prev_zero & (xi == 0)
+        return out
+
+    pos_t = _lower_bound(sorted_ids, targets, n, lut=lut,
+                         lut_steps=None)               # [Q], fallback replies
 
     def reply_gather(x_rows, round_no):
         """Simulated answers of the α queried nodes per search.
         x_rows [Q, alpha] int32 (−1 = no request) → node rows [Q, R]."""
-        x_ids = jnp.take(sorted_ids, jnp.clip(x_rows, 0, N - 1), axis=0)  # [Q,a,5]
-        b = common_bits(x_ids, targets[:, None, :])                        # [Q,a]
+        x_l = gather_planar(x_rows)                                  # 5×[Q,a]
+        t_l = [targets[:, l:l + 1] for l in range(N_LIMBS)]
+        b = common_bits_planar(x_l, t_l)                             # [Q,a]
         prefix_len = jnp.clip(b + 1, 0, ID_BITS)
         lo, ub = _prefix_block_bounds(sorted_ids, n, targets[:, None, :]
-                                      .repeat(x_rows.shape[1], 1), prefix_len)
+                                      .repeat(x_rows.shape[1], 1), prefix_len,
+                                      lut=lut)
         size = jnp.maximum(ub - lo, 0)                                     # [Q,a]
 
         qi = jnp.arange(Q, dtype=_U32)[:, None, None]
@@ -161,24 +198,28 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
         rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
         return rows.reshape(Q, R)
 
-    def merge(cand_node, cand_dist, queried, new_rows):
+    def merge(cand_node, cand_l, queried, new_rows):
         """Insert replies, dedupe by node, keep the S closest
-        (↔ Search::insertNode, src/search.h:636-722)."""
-        new_ids = jnp.take(sorted_ids, jnp.clip(new_rows, 0, N - 1), axis=0)
-        new_dist = xor_ids(targets[:, None, :], new_ids)
-        node = jnp.concatenate([cand_node, new_rows], axis=1)          # [Q,S+R]
-        dist = jnp.concatenate([cand_dist, new_dist], axis=1)
+        (↔ Search::insertNode, src/search.h:636-722).  ``cand_l`` is the
+        candidate distance as 5 limb planes [Q, S]; everything stays 2-D."""
+        new_l = gather_planar(new_rows)                           # 5×[Q,R]
+        node = jnp.concatenate([cand_node, new_rows], axis=1)     # [Q,S+R]
+        d_l = [jnp.concatenate([cand_l[l], new_l[l] ^ targets[:, l:l + 1]],
+                               axis=1) for l in range(N_LIMBS)]
         qd = jnp.concatenate([queried, jnp.zeros((Q, R), jnp.int32)], axis=1)
         inv = (node < 0).astype(jnp.int32)
+        # new entries beyond the valid table (padded fallback rows for
+        # empty/absent requests) already arrive as -1 via reply_gather;
+        # their distance planes are garbage but masked by inv.
+        big = jnp.uint32(0xFFFFFFFF)
+        d_l = [jnp.where(inv == 0, dl, big) for dl in d_l]
         # sort by (invalid, dist, node, not-queried) so that among
         # duplicates of a node the already-queried copy comes first
         out = lax.sort(
-            (inv, dist[..., 0], dist[..., 1], dist[..., 2], dist[..., 3],
-             dist[..., 4], node, 1 - qd),
+            (inv, d_l[0], d_l[1], d_l[2], d_l[3], d_l[4], node, 1 - qd),
             dimension=1, num_keys=8,
         )
         inv_s, node_s = out[0], out[6]
-        dist_s = jnp.stack(out[1:6], axis=-1)
         qd_s = 1 - out[7]
         # dedupe: same node appears adjacently (same dist); drop repeats
         dup = jnp.concatenate(
@@ -186,17 +227,15 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
              (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)], axis=1)
         inv2 = jnp.where(dup, 1, inv_s)
         out2 = lax.sort(
-            (inv2, dist_s[..., 0], dist_s[..., 1], dist_s[..., 2],
-             dist_s[..., 3], dist_s[..., 4], node_s, 1 - qd_s),
+            (inv2, out[1], out[2], out[3], out[4], out[5], node_s, 1 - qd_s),
             dimension=1, num_keys=7,
         )
         present = out2[0][:, :S] == 0
         node_f = jnp.where(present, out2[6][:, :S], -1)
-        dist_f = jnp.where(present[..., None],
-                           jnp.stack(out2[1:6], axis=-1)[:, :S],
-                           jnp.uint32(0xFFFFFFFF))
+        d_f = [jnp.where(present, out2[1 + l][:, :S], big)
+               for l in range(N_LIMBS)]
         qd_f = (1 - out2[7])[:, :S] * present
-        return node_f, dist_f, qd_f
+        return node_f, d_f, qd_f
 
     # -- bootstrap: cold start from ONE pseudo-random bootstrap peer per
     # search (like a node boots from a single well-known host) ------------
@@ -207,10 +246,10 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
             (_mix32(jnp.arange(Q, dtype=_U32) ^ seed_u)
              % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32)))
     cand_node = jnp.full((Q, S), -1, jnp.int32)
-    cand_dist = jnp.full((Q, S, N_LIMBS), 0xFFFFFFFF, _U32)
+    cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(N_LIMBS)]
     queried = jnp.zeros((Q, S), jnp.int32)
     first = reply_gather(boot, jnp.int32(0))
-    cand_node, cand_dist, queried = merge(cand_node, cand_dist, queried, first)
+    cand_node, cand_l, queried = merge(cand_node, cand_l, queried, first)
 
     def synced(cand_node, queried):
         """First min(k, #candidates) candidates all answered
@@ -222,11 +261,11 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
             jnp.any(present, axis=1)
 
     def cond(state):
-        _, _, _, _, done, round_no = state
+        done, round_no = state[4], state[5]
         return (~jnp.all(done)) & (round_no < max_hops)
 
     def body(state):
-        cand_node, cand_dist, queried, hops, done, round_no = state
+        cand_node, cand_l, queried, hops, done, round_no = state
         # select the closest α unqueried candidates per active search
         # (↔ searchSendGetValues picking SearchNodes with canGet,
         #  src/dht.cpp:628-639)
@@ -244,8 +283,8 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
 
         new_rows = reply_gather(x_rows, round_no + 1)
         queried = jnp.where(sel, 1, queried)
-        cand_node, cand_dist, queried = merge(
-            cand_node, cand_dist, queried, new_rows)
+        cand_node, cand_l, queried = merge(
+            cand_node, cand_l, queried, new_rows)
 
         now_done = synced(cand_node, queried)
         stalled = ~jnp.any((cand_node >= 0) & (queried == 0), axis=1)
@@ -254,18 +293,18 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
         # scalar reference's stall return path)
         hops = jnp.where(~done & sent, hops + 1, hops)
         done = done | now_done | stalled
-        return cand_node, cand_dist, queried, hops, done, round_no + 1
+        return cand_node, cand_l, queried, hops, done, round_no + 1
 
-    state = (cand_node, cand_dist, queried,
+    state = (cand_node, cand_l, queried,
              jnp.zeros((Q,), jnp.int32),
              synced(cand_node, queried) | empty,
              jnp.int32(0))
-    cand_node, cand_dist, queried, hops, done, _ = \
+    cand_node, cand_l, queried, hops, done, _ = \
         lax.while_loop(cond, body, state)
 
     return {
         "nodes": cand_node[:, :k],
-        "dist": cand_dist[:, :k],
+        "dist": jnp.stack([cl[:, :k] for cl in cand_l], axis=-1),
         "hops": hops,
         "converged": synced(cand_node, queried) & ~empty,
     }

@@ -89,22 +89,30 @@ LUT_BITS = 16
 LUT_BUCKET_STEPS = 13
 
 
-@jax.jit
-def build_prefix_lut(sorted_ids, n_valid):
-    """Top-16-bit prefix → first sorted row with that prefix or greater.
+@functools.partial(jax.jit, static_argnames=("bits",))
+def build_prefix_lut(sorted_ids, n_valid, *, bits: int = LUT_BITS):
+    """Top-``bits`` prefix → first sorted row with that prefix or greater.
 
     Shrinks the per-query binary search from ceil(log2 N)+1 sequential
-    gather steps to LUT_BUCKET_STEPS, which is where a third of the
-    lookup wall-clock goes at N=1M.  Invalid rows (sorted to the end)
-    get the sentinel prefix 2^16 so every real prefix resolves below
-    n_valid.  Returns int32 [2^16 + 1]; entry [p+1] bounds bucket p.
+    gather steps to a handful of in-bucket steps, which is where a third
+    of the lookup wall-clock goes at N=1M.  Invalid rows (sorted to the
+    end) get the sentinel prefix 2^bits so every real prefix resolves
+    below n_valid.  Returns int32 [2^bits + 1]; entry [p+1] bounds
+    bucket p.  ``bits`` is recoverable from the result shape, so
+    consumers infer it — pass 20 for million-row tables (4 MiB LUT,
+    ~1-row buckets) and keep the 16-bit default for small ones.
     """
     N = sorted_ids.shape[0]
-    keys = (sorted_ids[:, 0] >> jnp.uint32(32 - LUT_BITS)).astype(jnp.int32)
+    keys = (sorted_ids[:, 0] >> jnp.uint32(32 - bits)).astype(jnp.int32)
     keys = jnp.where(jnp.arange(N) < jnp.asarray(n_valid, jnp.int32),
-                     keys, jnp.int32(1 << LUT_BITS))
-    probes = jnp.arange((1 << LUT_BITS) + 1, dtype=jnp.int32)
+                     keys, jnp.int32(1 << bits))
+    probes = jnp.arange((1 << bits) + 1, dtype=jnp.int32)
     return jnp.searchsorted(keys, probes, side="left").astype(jnp.int32)
+
+
+def _lut_bits(lut) -> int:
+    """Recover the prefix width from a build_prefix_lut result shape."""
+    return (lut.shape[0] - 1).bit_length() - 1
 
 
 def _lower_bound(sorted_ids, queries, n_valid, lut=None,
@@ -119,9 +127,14 @@ def _lower_bound(sorted_ids, queries, n_valid, lut=None,
     N = sorted_ids.shape[0]
     Q = queries.shape[0]
     if lut is not None:
-        p = (queries[:, 0] >> jnp.uint32(32 - LUT_BITS)).astype(jnp.int32)
+        bits = _lut_bits(lut)
+        p = (queries[:, 0] >> jnp.uint32(32 - bits)).astype(jnp.int32)
         lo = jnp.take(lut, p)
         hi = jnp.take(lut, p + 1)
+        if lut_steps is None:
+            # cover buckets up to 2^6 × the expected N/2^bits size;
+            # larger (adversarial) buckets merely fail the certificate
+            lut_steps = max(6, math.ceil(math.log2(max(N, 2))) - bits + 6)
         steps = lut_steps
     else:
         steps = max(1, math.ceil(math.log2(max(N, 2))) + 1)
@@ -210,48 +223,222 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
         top_dist = jnp.where((top_inv == 0)[..., None], top_dist,
                              jnp.full_like(top_dist, 0xFFFFFFFF))
 
-    # ---- exactness certificate ------------------------------------------
-    # Nodes excluded on the left are all at sorted index < start; the
-    # closest-in-order one is start-1 and (prefix monotonicity) carries the
-    # maximal common prefix cbL among them.  Any excluded node's distance
-    # is >= 2^(159-cbL), while the kth window result's distance is
-    # < 2^(160-cp_k); cp_k > cbL makes every window top-k strictly closer
-    # than every excluded node.  Symmetrically on the right.
-    # recover the kth id from its distance (id = q ^ dist)
-    kth_dist = top_dist[:, k - 1]
-    kth_valid = top_inv[:, k - 1] == 0
-    kth_ids = xor_ids(queries, kth_dist)
-    cp_k = common_bits(queries, kth_ids)
-
-    left_exists = start > 0
-    right_exists = (start + window) < n_valid
     left_ids = jnp.take(sorted_ids, jnp.clip(start - 1, 0, N - 1), axis=0)
     right_ids = jnp.take(sorted_ids, jnp.clip(start + window, 0, N - 1), axis=0)
+    certified = _window_certificate(
+        queries, top_dist[:, k - 1], top_inv[:, k - 1] == 0,
+        left_ids, right_ids, start > 0, (start + window) < n_valid)
+    return top_dist, top_idx, certified
+
+
+def _window_certificate(queries, kth_dist, kth_valid, left_ids, right_ids,
+                        left_exists, right_exists):
+    """Exactness certificate shared by the window and expanded lookups.
+
+    Nodes excluded on the left are all at sorted index < start; the
+    closest-in-order one is start-1 and (prefix monotonicity) carries the
+    maximal common prefix cbL among them.  Any excluded node's distance
+    is >= 2^(159-cbL), while the kth window result's distance is
+    < 2^(160-cp_k); cp_k > cbL makes every window top-k strictly closer
+    than every excluded node.  Symmetrically on the right.
+    """
+    # recover the kth id from its distance (id = q ^ dist)
+    kth_ids = xor_ids(queries, kth_dist)
+    cp_k = common_bits(queries, kth_ids)
     cbL = common_bits(queries, left_ids)
     cbR = common_bits(queries, right_ids)
-
     covers_all = (~left_exists) & (~right_exists)
     ok_left = (~left_exists) | (cp_k > cbL)
     ok_right = (~right_exists) | (cp_k > cbR)
-    certified = covers_all | (kth_valid & ok_left & ok_right)
+    return covers_all | (kth_valid & ok_left & ok_right)
+
+
+# ---------------------------------------------------------------------------
+# Expanded-table path: window fetch as ONE row gather.
+#
+# Measured on the real chip (v5e), XLA lowers the [Q·W]-element window
+# gather of window_topk to a per-element gather running at ~190K rows/ms
+# (~4 GB/s — 200× under HBM bandwidth), which is >80% of lookup
+# wall-clock at Q=131072, N=1M.  Row gathers with wide contiguous rows,
+# by contrast, run near memory speed ([131072, 128] uint32 rows in
+# ~0.5 ms).  So we trade 3× table memory for gather shape: the sorted
+# table is pre-expanded into overlapping window *rows*
+#
+#   expanded[j] = sorted_ids[64·j : 64·j + 192]        (stride 64, len 192)
+#
+# built with reshape+concat only (no gather).  Any 128-wide window
+# [pos-64, pos+64) is contained in row j = floor((pos-64)/64), so one
+# [Q]-index row gather fetches every query's full candidate set; near
+# the table end j is clamped so the window's valid part reaches
+# n_valid, mirroring window_topk's slide.  The same exactness
+# certificate applies with window start 64·j.
+# ---------------------------------------------------------------------------
+
+EXPAND_STRIDE = 64
+EXPAND_LEN = 3 * EXPAND_STRIDE          # candidate window rows per entry
+_EROW = EXPAND_LEN + 2                  # + left/right certificate neighbors
+
+
+@jax.jit
+def expand_table(sorted_ids):
+    """[N, 5] sorted ids → [ceil(N/64), 5·194] overlapping window rows.
+
+    Row j holds sorted rows [64j-1, 64j+193) in **limb-planar** order:
+    lanes [l·194, (l+1)·194) are limb l of those 194 rows.  Within each
+    plane, lane 0 is the *left certificate neighbor* (row 64j-1; zeros
+    sentinel for j=0), lanes 1..192 the candidate window
+    [64j, 64j+192), lane 193 the *right certificate neighbor* — so one
+    row gather fetches both the full candidate set and the rows the
+    exactness certificate compares against.
+
+    Limb-planar layout matters: a [Q, W, 5] candidate tensor pads its
+    minor dim 5 → 128 lanes in TPU tiled layout (25× physical memory,
+    measured ~13 GB of traffic per 131K-query batch).  Keeping each
+    limb a contiguous lane slice of a 2-D row keeps every downstream
+    op 2-D and unpadded.  Rows past the end are zero-padded (excluded
+    at lookup time via n_valid masking).  Pure pad/reshape/concat — no
+    gather.
+    """
+    N = sorted_ids.shape[0]
+    NB = -(-N // EXPAND_STRIDE)
+    nblk = NB + 4
+    pad = nblk * EXPAND_STRIDE - N - 1
+    padded = jnp.pad(sorted_ids, ((1, pad), (0, 0)))    # padded[i] = sorted[i-1]
+    planes = []
+    for l in range(N_LIMBS):
+        Bl = padded[:, l].reshape(nblk, EXPAND_STRIDE)
+        planes.append(jnp.concatenate(
+            [Bl[:NB], Bl[1:NB + 1], Bl[2:NB + 2], Bl[3:NB + 3, :2]], axis=1))
+    return jnp.concatenate(planes, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps"))
+def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
+                  select: str = "auto", lut=None, lut_steps=None):
+    """k XOR-closest via the expanded table — one row gather per query.
+
+    ``select``: ``"pallas"`` = fused min-extraction kernel
+    (ops/pallas_window_topk.py — exact 5-limb ordering, but measured
+    slower than the sorts on v5e; see below); ``"sort"`` = full 7-key
+    lexicographic sort (always exact
+    in-window); ``"fast3"`` = 3-key comparator (invalid, d0, d1) with
+    limbs 2-4 riding as payload — exact unless two candidates tie on
+    the top 64 distance bits (≈2^-47 per pair; detected by an
+    adjacent-tie check over the first k+1 sorted rows and folded into
+    ``certified``, so ties fall back like any uncertified query).
+    ``"auto"`` = fast3 everywhere — measured on v5e, the XLA bitonic
+    sort beats the pallas min-extraction kernel (17.7 ms vs ~78 ms per
+    131K×192 batch; Mosaic cross-lane reductions cost ~1000 cycles
+    each, and the kernel needs 6 per extraction round), so the pallas
+    path stays opt-in as a recorded negative result.
+
+    Returns (dist [Q,k,5], idx [Q,k] sorted-table rows, certified [Q])
+    with the same contract as :func:`window_topk`.
+    """
+    if select == "auto":
+        select = "fast3"
+    NB = expanded.shape[0]
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+
+    pos = _lower_bound(sorted_ids, queries, n_valid, lut=lut,
+                       lut_steps=lut_steps)
+    # slide at the table end like window_topk: clamp j so the window's
+    # valid part always reaches n_valid (jmax start + 192 ≥ n_valid, at
+    # most 63 masked lanes at the top).  Without this clamp, queries in
+    # the last ~128 rows keep a one-sided window and decertify — which
+    # is sound but needlessly falls back (and in the sharded path flips
+    # the whole-shard exact-scan cond).
+    jmax = jnp.clip(-((EXPAND_LEN - n_valid) // EXPAND_STRIDE), 0, NB - 1)
+    j = jnp.clip((pos - EXPAND_STRIDE) // EXPAND_STRIDE, 0, jmax)
+    start = j * EXPAND_STRIDE
+
+    rows = jnp.take(expanded, j, axis=0)                   # [Q, 5·194]
+    # limb planes — contiguous lane slices, everything stays 2-D
+    plane = [rows[:, l * _EROW:(l + 1) * _EROW] for l in range(N_LIMBS)]
+    left_ids = jnp.stack([p[:, 0] for p in plane], axis=-1)
+    right_ids = jnp.stack([p[:, _EROW - 1] for p in plane], axis=-1)
+
+    if select == "pallas":
+        from .pallas_window_topk import window_select
+        Q = queries.shape[0]
+        q8 = jnp.pad(queries, ((0, 0), (0, 8 - N_LIMBS)))
+        bounds = jnp.broadcast_to(
+            jnp.clip(n_valid - start, 0, EXPAND_LEN)[:, None], (Q, 8)
+        ).astype(jnp.int32)
+        packed = window_select(rows, q8, bounds, k=k,
+                               interpret=jax.default_backend() != "tpu")
+        local = packed[:, N_LIMBS * k:(N_LIMBS + 1) * k].astype(jnp.int32)
+        gidx = start[:, None] + local
+        valid_k = (local < EXPAND_LEN) & (gidx < n_valid)
+        top_limbs = [jnp.where(valid_k, packed[:, l * k:(l + 1) * k],
+                               jnp.uint32(0xFFFFFFFF))
+                     for l in range(N_LIMBS)]
+        top_idx = jnp.where(valid_k, gidx, -1)
+        top_dist = jnp.stack(top_limbs, axis=-1)           # single 3-D build
+    else:
+        d = [p[:, 1:_EROW - 1] ^ queries[:, l:l + 1]
+             for l, p in enumerate(plane)]                 # 5 × [Q, 192]
+        gr = start[:, None] + jnp.arange(EXPAND_LEN, dtype=jnp.int32)[None, :]
+        inv = (gr >= n_valid).astype(jnp.int32)
+
+        num_keys = 7 if select == "sort" else 3
+        out = lax.sort((inv, d[0], d[1], d[2], d[3], d[4], gr),
+                       dimension=1, num_keys=num_keys)
+        top_inv = out[0][:, :k]
+        valid_k = top_inv == 0
+        top_limbs = [jnp.where(valid_k, out[1 + l][:, :k],
+                               jnp.uint32(0xFFFFFFFF))
+                     for l in range(N_LIMBS)]
+        top_idx = jnp.where(valid_k, out[6][:, :k], -1)
+        top_dist = jnp.stack(top_limbs, axis=-1)           # single 3-D build
+
+    # window certificate (same argument as window_topk, start = 64j);
+    # neighbor rows came along in the gathered row — no extra gather.
+    kth_dist = jnp.stack([tl[:, k - 1] for tl in top_limbs], axis=-1)
+    certified = _window_certificate(
+        queries, kth_dist, valid_k[:, k - 1], left_ids, right_ids,
+        start > 0, (start + EXPAND_LEN) < n_valid)
+
+    if select == "fast3":
+        # fast3 exactness: no adjacent (d0, d1) tie among the first k+1
+        # valid sorted rows (a tie anywhere in the sorted order is an
+        # adjacent tie; ties past position k cannot change the top-k set
+        # or its order).
+        a0 = out[1][:, :k + 1]
+        a1 = out[2][:, :k + 1]
+        av = out[0][:, :k + 1] == 0
+        tie = jnp.any((a0[:, 1:] == a0[:, :-1]) & (a1[:, 1:] == a1[:, :-1])
+                      & av[:, 1:] & av[:, :-1], axis=1)
+        certified = certified & ~tie
     return top_dist, top_idx, certified
 
 
 def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
                 fallback: bool = True, lut=None,
-                lut_steps: int = LUT_BUCKET_STEPS):
+                lut_steps=None, expanded=None,
+                select: str = "fast3"):
     """Window lookup with exact fallback: uncertified queries re-run
     through the full-scan oracle so the result is always exact (when
     ``fallback=True``; with ``fallback=False`` rows where the returned
     ``certified`` mask is False may be inexact).
 
+    With ``expanded`` (from :func:`expand_table`) the fast row-gather
+    path (:func:`expanded_topk`) replaces the per-element window gather.
+
     Host-level driver (the fallback set is data-dependent); the common
     path is a single device call.  Returns (dist [Q,k,5],
     idx [Q,k] int32 into the *sorted* table, certified [Q] bool).
     """
-    dist, idx, cert = window_topk(sorted_ids, n_valid, queries, k=k,
-                                  window=window, lut=lut,
-                                  lut_steps=lut_steps)
+    if expanded is not None:
+        dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
+                                        queries, k=k, select=select,
+                                        lut=lut, lut_steps=lut_steps)
+    else:
+        dist, idx, cert = window_topk(sorted_ids, n_valid, queries, k=k,
+                                      window=window, lut=lut,
+                                      lut_steps=(LUT_BUCKET_STEPS
+                                                 if lut_steps is None
+                                                 else lut_steps))
     if not fallback:
         return dist, idx, cert
     cert_host = jax.device_get(cert)

@@ -5,6 +5,7 @@ from .sharded import (  # noqa: F401
     pad_to_multiple,
     sharded_xor_topk,
     sharded_sort_table,
+    sharded_expand_table,
     sharded_window_lookup,
     sharded_lookup,
     dp_simulate_lookups,

@@ -39,7 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ids import N_LIMBS
 from ..ops.xor_topk import xor_topk, select_topk, mask_invalid
-from ..ops.sorted_table import sort_table, window_topk
+from ..ops.sorted_table import (sort_table, window_topk, build_prefix_lut,
+                                expand_table, expanded_topk, _EROW)
 from ..core.search import simulate_lookups
 
 _U32 = jnp.uint32
@@ -160,15 +161,46 @@ def sharded_sort_table(mesh: Mesh, table, valid=None):
     return fn(jnp.asarray(table, _U32), jnp.asarray(valid))
 
 
+@functools.lru_cache(maxsize=8)
+def _build_sharded_expand(mesh: Mesh, bits: int):
+    def local(sorted_ids, n_valid_shard):
+        expanded = expand_table(sorted_ids)
+        lut = build_prefix_lut(sorted_ids, n_valid_shard[0], bits=bits)
+        return expanded, lut[None]
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("t", None), P("t")),
+        out_specs=(P("t", None), P("t", None)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_expand_table(mesh: Mesh, sorted_ids, n_valid, *, bits: int = 16):
+    """Build each shard's expanded window-row table and prefix LUT
+    locally (no collectives) from :func:`sharded_sort_table` output.
+    Returns (expanded [n_t·NB, 970] sharded over ``t``,
+    lut [n_t, 2^bits+1] sharded over ``t``) to feed the expanded fast
+    path of :func:`sharded_window_lookup`."""
+    fn = _build_sharded_expand(mesh, bits)
+    return fn(jnp.asarray(sorted_ids, _U32), jnp.asarray(n_valid, jnp.int32))
+
+
 @functools.lru_cache(maxsize=64)
-def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int, shard_n: int):
+def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int,
+                                 shard_n: int, use_expanded: bool):
     n_t = mesh.shape["t"]
 
-    def local(q, sorted_ids, perm, n_valid_shard):
+    def local(q, sorted_ids, perm, n_valid_shard, expanded, lut):
         ti = lax.axis_index("t")
         n_valid = n_valid_shard[0]
-        dist, sidx, cert = window_topk(sorted_ids, n_valid, q, k=k,
-                                       window=window)
+        if use_expanded:
+            dist, sidx, cert = expanded_topk(sorted_ids, expanded, n_valid,
+                                             q, k=k, lut=lut[0])
+        else:
+            dist, sidx, cert = window_topk(sorted_ids, n_valid, q, k=k,
+                                           window=window)
 
         # Certificate fallback: when any row in this shard's batch is
         # uncertified, rerun the whole shard through the exact scan and
@@ -193,7 +225,8 @@ def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int, shard_n: int):
 
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("q", None), P("t", None), P("t"), P("t")),
+        in_specs=(P("q", None), P("t", None), P("t"), P("t"),
+                  P("t", None), P("t", None)),
         out_specs=(P("q", None, None), P("q", None)),
         check_vma=False,
     )
@@ -201,7 +234,8 @@ def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int, shard_n: int):
 
 
 def sharded_window_lookup(mesh: Mesh, queries, sorted_ids, perm, n_valid, *,
-                          k: int = 8, window: int = 128):
+                          k: int = 8, window: int = 128, expanded=None,
+                          lut=None):
     """Exact k XOR-closest over a pre-sorted row-sharded table — the
     repeated-lookup fast path.  Takes the output of
     :func:`sharded_sort_table`; each shard answers with its local window
@@ -209,15 +243,27 @@ def sharded_window_lookup(mesh: Mesh, queries, sorted_ids, perm, n_valid, *,
     to the shard-local full scan), then the per-shard winners are
     all_gather-merged over ``t``.
 
+    Pass ``expanded``/``lut`` from :func:`sharded_expand_table` to use
+    the expanded row-gather fast path per shard (the headline-bench
+    kernel) instead of the per-element window gather.
+
     Same contract as :func:`sharded_xor_topk`: returns
     (dist [Q, k, 5], idx [Q, k]) where idx are **global original-table
     row indices** (-1 padding), sharded over ``q``.
     """
     N = sorted_ids.shape[0]
-    shard_n = N // mesh.shape["t"]
-    fn = _build_sharded_window_lookup(mesh, k, min(window, shard_n), shard_n)
+    n_t = mesh.shape["t"]
+    shard_n = N // n_t
+    use_expanded = expanded is not None
+    if not use_expanded:
+        # placeholder operands keep one shard_map signature for both paths
+        expanded = jnp.zeros((n_t, N_LIMBS * _EROW), _U32)
+        lut = jnp.zeros((n_t, 2), jnp.int32)
+    fn = _build_sharded_window_lookup(mesh, k, min(window, shard_n), shard_n,
+                                      use_expanded)
     return fn(jnp.asarray(queries, _U32), jnp.asarray(sorted_ids, _U32),
-              jnp.asarray(perm, jnp.int32), jnp.asarray(n_valid, jnp.int32))
+              jnp.asarray(perm, jnp.int32), jnp.asarray(n_valid, jnp.int32),
+              jnp.asarray(expanded, _U32), jnp.asarray(lut, jnp.int32))
 
 
 def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,

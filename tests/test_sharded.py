@@ -123,3 +123,22 @@ def test_dp_simulate_matches_unsharded(mesh):
     np.testing.assert_array_equal(np.asarray(out["hops"]), np.asarray(ref["hops"]))
     np.testing.assert_array_equal(
         np.asarray(out["converged"]), np.asarray(ref["converged"]))
+
+
+def test_sharded_expanded_lookup_matches_full_scan(mesh):
+    """The per-shard expanded row-gather path (sharded_expand_table +
+    expanded lookup) is exact vs the full-scan oracle — the headline
+    kernel under table-parallel sharding."""
+    from opendht_tpu.parallel import sharded_expand_table
+    rng = np.random.default_rng(21)
+    table = _rand_ids(rng, 1024)
+    sorted_ids, perm, n_valid = sharded_sort_table(mesh, table)
+    expanded, lut = sharded_expand_table(mesh, sorted_ids, n_valid)
+    for batch in range(2):
+        queries = _rand_ids(rng, 8 * mesh.shape["q"])
+        d_ref, i_ref = xor_topk(jnp.asarray(queries), jnp.asarray(table), k=8)
+        d_sh, rows = sharded_window_lookup(mesh, queries, sorted_ids, perm,
+                                           n_valid, k=8, expanded=expanded,
+                                           lut=lut)
+        np.testing.assert_array_equal(np.asarray(d_sh), np.asarray(d_ref))
+        np.testing.assert_array_equal(np.asarray(rows), np.asarray(i_ref))

@@ -211,3 +211,144 @@ def test_prefix_lut_lower_bound_parity():
     # lut and plain agree wherever both certify
     both = cert1 & cert2
     assert np.array_equal(np.asarray(i1)[both], np.asarray(i2)[both])
+
+
+# ---------------------------------------------------------------------------
+# expanded-table fast path (ops/sorted_table.expand_table / expanded_topk)
+# ---------------------------------------------------------------------------
+
+def _expanded_setup(table_raw, valid=None, bits=16):
+    from opendht_tpu.ops.sorted_table import build_prefix_lut, expand_table
+    ids = jnp.asarray(K.ids_from_bytes(table_raw))
+    v = None if valid is None else jnp.asarray(valid)
+    sorted_ids, perm, n_valid = sort_table(ids, v)
+    lut = build_prefix_lut(sorted_ids, n_valid, bits=bits)
+    T2 = expand_table(sorted_ids)
+    return sorted_ids, perm, n_valid, lut, T2
+
+
+def test_expand_table_rows_cover_windows():
+    """Row j of the expanded table is limb-planar sorted rows
+    [64j-1, 64j+193), with zero sentinels at both ends."""
+    from opendht_tpu.ops.sorted_table import (expand_table, EXPAND_STRIDE,
+                                              _EROW)
+    table_raw = _rand_raw(300, 40)
+    ids = jnp.asarray(K.ids_from_bytes(table_raw))
+    sorted_ids, _, _ = sort_table(ids)
+    T2 = np.asarray(expand_table(sorted_ids))
+    s = np.asarray(sorted_ids)
+    NB = T2.shape[0]
+    assert NB == -(-300 // EXPAND_STRIDE)
+    padded = np.concatenate(
+        [np.zeros((1, 5), np.uint32), s,
+         np.zeros((_EROW,), np.uint32).repeat(5).reshape(-1, 5)])
+    for j in range(NB):
+        want = padded[64 * j: 64 * j + _EROW]          # [194, 5]
+        got = T2[j].reshape(5, _EROW).T                # limb-planar → [194, 5]
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("select", ["fast3", "sort", "pallas"])
+@pytest.mark.parametrize("bits", [16, 20])
+def test_expanded_topk_certified_matches_oracle(select, bits):
+    from opendht_tpu.ops.sorted_table import expanded_topk
+    table_raw = _rand_raw(4096, 41)
+    table_raw[100] = table_raw[50]            # duplicate id
+    q_raw = _rand_raw(64, 42)
+    q_raw[1] = table_raw[5]                   # distance-0 case
+    valid = np.ones(4096, bool)
+    valid[::7] = False
+    sorted_ids, perm, n_valid, lut, T2 = _expanded_setup(
+        table_raw, valid, bits=bits)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    dist, idx, cert = expanded_topk(sorted_ids, T2, n_valid, q, k=8,
+                                    select=select, lut=lut)
+    cert = np.asarray(cert)
+    assert cert.mean() > 0.9
+    p = np.asarray(perm)
+    for qi in range(64):
+        if not cert[qi]:
+            continue
+        want = _oracle_topk(q_raw[qi], table_raw, 8, valid)
+        got = [p[j] for j in np.asarray(idx[qi]) if j >= 0]
+        want_d = [w[0] for w in want]
+        got_d = [
+            int.from_bytes(K.ids_to_bytes(np.asarray(dist[qi, j])).tobytes(),
+                           "big")
+            for j in range(len(got))
+        ]
+        assert got_d == want_d, f"query {qi}"
+
+
+@pytest.mark.parametrize("select", ["fast3", "pallas"])
+def test_expanded_lookup_fallback_exact_under_clustering(select):
+    """Adversarial shared prefixes overflow LUT buckets and windows; the
+    certificate must catch every such query and lookup_topk's fallback
+    must restore exactness."""
+    table_raw = _rand_raw(2048, 43, cluster=10)
+    q_raw = table_raw[:32].copy()
+    q_raw[:, 19] ^= 0xFF
+    sorted_ids, perm, n_valid, lut, T2 = _expanded_setup(table_raw)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    dist, idx, cert = lookup_topk(sorted_ids, n_valid, q, k=8, lut=lut,
+                                  expanded=T2, select=select)
+    assert bool(np.asarray(cert).all())
+    for qi in range(32):
+        want_d = [w[0] for w in _oracle_topk(q_raw[qi], table_raw, 8)]
+        got_d = [
+            int.from_bytes(K.ids_to_bytes(np.asarray(dist[qi, j])).tobytes(),
+                           "big")
+            for j in range(8)
+        ]
+        assert got_d == want_d, f"query {qi}"
+
+
+def test_expanded_fast3_tie_certificate():
+    """Ids sharing their top 64 bits make the fast3 (d0, d1) comparator
+    ambiguous; those queries must come back uncertified (and exact via
+    fallback), never silently mis-ordered."""
+    from opendht_tpu.ops.sorted_table import expanded_topk
+    rng = np.random.default_rng(44)
+    table_raw = rng.integers(0, 256, size=(512, 20), dtype=np.uint8)
+    # 16 ids with identical first 8 bytes, distinct tails
+    table_raw[:16, :8] = table_raw[0, :8]
+    q_raw = table_raw[:4].copy()              # queries inside the tie cluster
+    q_raw[:, 12] ^= 0x55
+    sorted_ids, perm, n_valid, lut, T2 = _expanded_setup(table_raw)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    _, _, cert = expanded_topk(sorted_ids, T2, n_valid, q, k=8,
+                               select="fast3", lut=lut)
+    assert not bool(np.asarray(cert).any())   # every tied query flagged
+    # fallback restores exactness
+    dist, idx, cert2 = lookup_topk(sorted_ids, n_valid, q, k=8, lut=lut,
+                                   expanded=T2, select="fast3")
+    assert bool(np.asarray(cert2).all())
+    for qi in range(4):
+        want_d = [w[0] for w in _oracle_topk(q_raw[qi], table_raw, 8)]
+        got_d = [
+            int.from_bytes(K.ids_to_bytes(np.asarray(dist[qi, j])).tobytes(),
+                           "big")
+            for j in range(8)
+        ]
+        assert got_d == want_d, f"query {qi}"
+
+
+@pytest.mark.parametrize("select", ["fast3", "pallas"])
+def test_expanded_topk_small_tables(select):
+    from opendht_tpu.ops.sorted_table import expanded_topk
+    for n, nv in [(8, 5), (64, 64), (70, 66), (200, 1)]:
+        table_raw = _rand_raw(n, 45 + n)
+        valid = np.arange(n) < nv
+        sorted_ids, perm, n_valid, lut, T2 = _expanded_setup(table_raw, valid)
+        q_raw = _rand_raw(33, 46 + n)
+        q = jnp.asarray(K.ids_from_bytes(q_raw))
+        dist, idx, cert = expanded_topk(sorted_ids, T2, n_valid, q, k=8,
+                                        select=select, lut=lut)
+        assert bool(np.asarray(cert).all()), (n, nv)
+        idx = np.asarray(idx)
+        assert ((idx >= 0).sum(axis=1) == min(nv, 8)).all(), (n, nv)
+        p = np.asarray(perm)
+        for qi in range(33):
+            want = _oracle_topk(q_raw[qi], table_raw, 8, valid)
+            got = [p[j] for j in idx[qi] if j >= 0]
+            assert got == [w[1] for w in want], (n, nv, qi)
