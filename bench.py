@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
-                                          expand_table, expanded_topk)
+                                          default_lut_bits, expand_table,
+                                          expanded_topk)
 from opendht_tpu.ops.xor_topk import xor_topk
 
 K = 16
@@ -104,12 +105,29 @@ def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
         return lax.while_loop(cond, step,
                               (jnp.int32(0), jnp.zeros((), jnp.float32)))[1]
 
-    float(g(example, jnp.int32(r2), *consts))     # compile + warm
+    for attempt in range(3):                      # compile + warm; the
+        try:                                      # remote-compile tunnel
+            float(g(example, jnp.int32(r2), *consts))   # flakes transiently
+            break
+        except Exception:
+            if attempt == 2:
+                raise
+            time.sleep(5)
     def timed(reps):
         return best_of(lambda: float(g(example, jnp.int32(reps), *consts)),
                        tries)
 
-    return (timed(r2) - timed(r1)) / (r2 - r1)
+    per = (timed(r2) - timed(r1)) / (r2 - r1)
+    if per <= 0:
+        # jitter swamped the rep separation — widen once, then fail
+        # loudly rather than publish a nonsensical number
+        per = (timed(4 * r2) - timed(4 * r1)) / (4 * (r2 - r1))
+        if per <= 0:
+            raise RuntimeError(
+                f"chain_slope non-positive ({per!r}) even at reps "
+                f"{4 * r1}/{4 * r2}; workload too small for the noise "
+                f"floor — raise r1/r2")
+    return per
 
 
 def measure() -> dict:
@@ -117,7 +135,7 @@ def measure() -> dict:
     on_accel = platform not in ("cpu",)
     N = 1_000_000 if on_accel else 100_000
     Q = 131_072 if on_accel else 8_192
-    lut_bits = 20 if N >= (1 << 18) else 16
+    lut_bits = default_lut_bits(N)
 
     key = jax.random.PRNGKey(0)
     k1, k2 = jax.random.split(key)
@@ -130,22 +148,33 @@ def measure() -> dict:
     expanded = jax.block_until_ready(expand_table(sorted_ids))
 
     def lookup(q, sorted_ids, expanded, n_valid, lut):
+        # fast2 = the findClosestNodes contract (nodes, not distances):
+        # the sort carries 4 operands instead of 7 (sort cost is linear
+        # in operand count), with a conservative certificate
         d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
-                                  lut=lut)
-        return jnp.sum(c.astype(jnp.float32))
+                                  select="fast2", lut=lut)
+        return (jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
 
     per_batch = chain_slope(lookup, queries, sorted_ids, expanded, n_valid,
                             lut)
     rate = Q / per_batch
 
-    # exactness + certificate fraction vs the full-scan oracle
-    d, i, cert = jax.block_until_ready(
-        expanded_topk(sorted_ids, expanded, n_valid, queries, k=K, lut=lut))
+    # exactness + certificate fraction vs the full-scan oracle: the timed
+    # fast2 path must return the oracle's node set/order, and the fuller
+    # fast3 path the oracle's distances too
+    _, i2, cert = jax.block_until_ready(
+        expanded_topk(sorted_ids, expanded, n_valid, queries, k=K,
+                      select="fast2", lut=lut))
     cert_frac = float(np.asarray(cert).mean())
+    d3, i3, _ = jax.block_until_ready(
+        expanded_topk(sorted_ids, expanded, n_valid, queries[:256], k=K,
+                      lut=lut))
     d_ref, i_ref = xor_topk(queries[:256], sorted_ids, k=K,
                             valid=jnp.arange(N) < n_valid)
-    exact = bool(np.array_equal(np.asarray(d[:256]), np.asarray(d_ref))
-                 and np.array_equal(np.asarray(i[:256]), np.asarray(i_ref)))
+    exact = bool(np.array_equal(np.asarray(i2[:256]), np.asarray(i_ref))
+                 and np.array_equal(np.asarray(i3), np.asarray(i_ref))
+                 and np.array_equal(np.asarray(d3), np.asarray(d_ref)))
 
     # scalar CPU baseline on the same sorted table
     def pack160(rows):

@@ -106,17 +106,23 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
     import jax.numpy as jnp
     from bench import chain_slope
     from opendht_tpu.core.search import simulate_lookups
-    from opendht_tpu.ops.sorted_table import sort_table
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits)
 
     on_accel = jax.devices()[0].platform != "cpu"
     N = N or (10_000_000 if on_accel else 100_000)
     Q = Q or (16_384 if on_accel else 1_024)
-    chunk = min(Q, chunk or (131_072 if on_accel else 1_024))
+    # measured optimum wave width on v5e (chunk sweep at -Q 1000000:
+    # 16384 → 63.2K/s, 131072 → 56.7K/s — smaller waves keep the
+    # while_loop's straggler tail short)
+    chunk = min(Q, chunk or (16_384 if on_accel else 1_024))
     key = jax.random.PRNGKey(3)
     k1, k2 = jax.random.split(key)
     table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
     targets = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
     sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
     del table
 
     n_waves = (Q + chunk - 1) // chunk
@@ -125,8 +131,8 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
         targets = jnp.concatenate([targets, targets[:pad]], axis=0)
     waves = [targets[i * chunk:(i + 1) * chunk] for i in range(n_waves)]
 
-    def run_wave(t, sorted_ids=sorted_ids, n_valid=n_valid):
-        return simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8)
+    def run_wave(t, sorted_ids=sorted_ids, n_valid=n_valid, lut=lut):
+        return simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8, lut=lut)
 
     # stats pass over the full burst (hops / convergence are exact)
     hops_all, conv_all = [], []
@@ -138,12 +144,13 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
     conv = float(np.concatenate(conv_all)[:Q].mean())
 
     # timed pass: serialized-chain slope of one wave
-    def body(t, sorted_ids, n_valid):
-        o = run_wave(t, sorted_ids, n_valid)
+    def body(t, sorted_ids, n_valid, lut):
+        o = run_wave(t, sorted_ids, n_valid, lut)
         return (jnp.sum(o["hops"].astype(jnp.float32))
                 + jnp.sum(o["converged"].astype(jnp.float32)))
 
-    wave_dt = chain_slope(body, waves[0], sorted_ids, n_valid, r1=1, r2=4)
+    wave_dt = chain_slope(body, waves[0], sorted_ids, n_valid, lut,
+                          r1=1, r2=4)
     dt = wave_dt * n_waves
     p50_wave = min((Q // 2) // chunk, n_waves - 1)
     return {"metric": "config3 iterative search sim, alpha=3 k=8, "
@@ -210,7 +217,7 @@ def config5() -> dict:
         sharded_sort_table(mesh, table))
     expanded, lut = jax.block_until_ready(
         sharded_expand_table(mesh, sorted_ids, n_valid,
-                             bits=20 if on_accel else 16))
+                             bits=default_lut_bits(N // mesh.shape['t'])))
 
     def body(q, sorted_ids, perm, n_valid, expanded, lut):
         d, idx = sharded_window_lookup(mesh, q, sorted_ids, perm, n_valid,

@@ -1,0 +1,24 @@
+#!/bin/sh
+# CI entry point (↔ the reference's travis/cmake test tier, SURVEY.md §4
+# tier 4): full test suite on the virtual 8-device CPU mesh, then the
+# driver entry checks and a CPU-scaled bench smoke.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q
+python - <<'PY'
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.block_until_ready(jax.jit(fn)(*args))
+g.dryrun_multichip(8)
+print("entry + dryrun ok")
+PY
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # env var alone loses to sitecustomize
+import bench
+bench.main()
+PY

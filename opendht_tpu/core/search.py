@@ -49,7 +49,7 @@ from jax import lax
 
 from ..ops.ids import N_LIMBS, ID_BITS, ids_to_bytes, clz32
 from ..ops.radix import _PREFIX_MASKS
-from ..ops.sorted_table import _lower_bound, build_prefix_lut
+from ..ops.sorted_table import _lower_bound, build_prefix_lut, default_lut_bits
 
 _U32 = jnp.uint32
 
@@ -105,7 +105,8 @@ def _prefix_block_bounds(sorted_ids, n, targets, prefix_len, lut=None):
 )
 def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
                      k: int = TARGET_NODES, alpha: int = ALPHA,
-                     search_nodes: int = SEARCH_NODES, max_hops: int = 48):
+                     search_nodes: int = SEARCH_NODES, max_hops: int = 48,
+                     lut=None):
     """Run Q iterative lookups to convergence against an N-node network.
 
     Args:
@@ -138,8 +139,11 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     # 64× the expected bucket size; the model stays deterministic
     # either way).
     sorted_t = sorted_ids.T                            # [5, N] one transpose
-    lut = build_prefix_lut(sorted_ids, n,
-                           bits=20 if N >= (1 << 18) else 16)
+    if lut is None:
+        # callers with a stable table should build this once with
+        # build_prefix_lut and pass it in — rebuilt here it costs a
+        # device searchsorted over N keys on every invocation
+        lut = build_prefix_lut(sorted_ids, n, bits=default_lut_bits(N))
 
     def gather_planar(rows):
         """rows [...] int32 → list of 5 limb arrays shaped like rows."""

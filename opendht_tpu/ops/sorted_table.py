@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .ids import N_LIMBS, xor_ids, common_bits, lex_lt
+from .ids import N_LIMBS, xor_ids, common_bits, clz32
 from .xor_topk import xor_topk
 
 _U32 = jnp.uint32
@@ -78,6 +78,13 @@ def sort_table(ids, valid=None):
 
 
 LUT_BITS = 16
+
+
+def default_lut_bits(n_rows: int) -> int:
+    """Prefix width for :func:`build_prefix_lut` sized to the table:
+    20 bits (~1-row buckets at 1M rows, 4 MiB LUT) once the table is
+    big enough to amortize it, else the 16-bit default."""
+    return 20 if n_rows >= (1 << 18) else 16
 # binary-search depth inside one LUT bucket: buckets of a 2^16-way
 # partition of N uniform ids are ~N/2^16 rows; 4096 (2^12) is a huge
 # overshoot for any realistic N, and an adversarial bucket larger than
@@ -141,11 +148,20 @@ def _lower_bound(sorted_ids, queries, n_valid, lut=None,
         lo = jnp.zeros((Q,), jnp.int32)
         hi = jnp.broadcast_to(jnp.asarray(n_valid, jnp.int32), (Q,))
 
+    # gather probe rows limb-planar from the transposed table: a [Q, 5]
+    # row gather pads 5 lanes → 128 in TPU tiled layout; [5, Q] columns
+    # stay unpadded and the lex compare runs on 1-D planes
+    sorted_t = sorted_ids.T                                  # [5, N]
+    q_l = [queries[:, l] for l in range(N_LIMBS)]
+
     def body(_, lohi):
         lo, hi = lohi
         mid = (lo + hi) // 2
-        mid_ids = jnp.take(sorted_ids, jnp.clip(mid, 0, N - 1), axis=0)
-        lt = lex_lt(mid_ids, queries)   # mid < q, 5-limb lexicographic
+        g = jnp.take(sorted_t, jnp.clip(mid, 0, N - 1), axis=1)   # [5, Q]
+        # mid < q, 5-limb lexicographic, planar
+        lt = g[N_LIMBS - 1] < q_l[N_LIMBS - 1]
+        for l in range(N_LIMBS - 2, -1, -1):
+            lt = (g[l] < q_l[l]) | ((g[l] == q_l[l]) & lt)
         go_right = lt & (lo < hi)
         new_lo = jnp.where(go_right, mid + 1, lo)
         new_hi = jnp.where(go_right | (lo >= hi), hi, mid)
@@ -225,13 +241,15 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
 
     left_ids = jnp.take(sorted_ids, jnp.clip(start - 1, 0, N - 1), axis=0)
     right_ids = jnp.take(sorted_ids, jnp.clip(start + window, 0, N - 1), axis=0)
+    # recover the kth id from its distance (id = q ^ dist)
+    kth_ids = xor_ids(queries, top_dist[:, k - 1])
     certified = _window_certificate(
-        queries, top_dist[:, k - 1], top_inv[:, k - 1] == 0,
+        queries, common_bits(queries, kth_ids), top_inv[:, k - 1] == 0,
         left_ids, right_ids, start > 0, (start + window) < n_valid)
     return top_dist, top_idx, certified
 
 
-def _window_certificate(queries, kth_dist, kth_valid, left_ids, right_ids,
+def _window_certificate(queries, cp_k, kth_valid, left_ids, right_ids,
                         left_exists, right_exists):
     """Exactness certificate shared by the window and expanded lookups.
 
@@ -240,11 +258,9 @@ def _window_certificate(queries, kth_dist, kth_valid, left_ids, right_ids,
     maximal common prefix cbL among them.  Any excluded node's distance
     is >= 2^(159-cbL), while the kth window result's distance is
     < 2^(160-cp_k); cp_k > cbL makes every window top-k strictly closer
-    than every excluded node.  Symmetrically on the right.
+    than every excluded node.  Symmetrically on the right.  ``cp_k`` may
+    be a lower bound — that only makes the certificate conservative.
     """
-    # recover the kth id from its distance (id = q ^ dist)
-    kth_ids = xor_ids(queries, kth_dist)
-    cp_k = common_bits(queries, kth_ids)
     cbL = common_bits(queries, left_ids)
     cbR = common_bits(queries, right_ids)
     covers_all = (~left_exists) & (~right_exists)
@@ -326,14 +342,23 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     the top 64 distance bits (≈2^-47 per pair; detected by an
     adjacent-tie check over the first k+1 sorted rows and folded into
     ``certified``, so ties fall back like any uncertified query).
+    ``"fast2"`` = like fast3 but limbs 2-4 are not carried at all —
+    the sort moves 4 operands instead of 7 (measured 7.5 ms vs 14.8 ms
+    per 131K×192 batch on v5e; sort cost is linear in operand count)
+    and ``dist`` comes back as ``None``.  The certificate then uses a
+    *lower bound* on the kth result's common prefix (exact below 64
+    bits, clamped at 64 above — conservative, so borderline queries
+    decertify rather than mis-certify).  Use it when the caller needs
+    nodes, not distances — the reference's ``findClosestNodes``
+    contract (src/routing_table.cpp:109-150).
     ``"auto"`` = fast3 everywhere — measured on v5e, the XLA bitonic
     sort beats the pallas min-extraction kernel (17.7 ms vs ~78 ms per
     131K×192 batch; Mosaic cross-lane reductions cost ~1000 cycles
     each, and the kernel needs 6 per extraction round), so the pallas
     path stays opt-in as a recorded negative result.
 
-    Returns (dist [Q,k,5], idx [Q,k] sorted-table rows, certified [Q])
-    with the same contract as :func:`window_topk`.
+    Returns (dist [Q,k,5] — ``None`` for fast2, idx [Q,k] sorted-table
+    rows, certified [Q]) with the same contract as :func:`window_topk`.
     """
     if select == "auto":
         select = "fast3"
@@ -376,34 +401,44 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
         top_idx = jnp.where(valid_k, gidx, -1)
         top_dist = jnp.stack(top_limbs, axis=-1)           # single 3-D build
     else:
-        d = [p[:, 1:_EROW - 1] ^ queries[:, l:l + 1]
-             for l, p in enumerate(plane)]                 # 5 × [Q, 192]
+        nd = 2 if select == "fast2" else N_LIMBS
+        d = [plane[l][:, 1:_EROW - 1] ^ queries[:, l:l + 1]
+             for l in range(nd)]                           # nd × [Q, 192]
         gr = start[:, None] + jnp.arange(EXPAND_LEN, dtype=jnp.int32)[None, :]
         inv = (gr >= n_valid).astype(jnp.int32)
 
         num_keys = 7 if select == "sort" else 3
-        out = lax.sort((inv, d[0], d[1], d[2], d[3], d[4], gr),
+        out = lax.sort((inv,) + tuple(d) + (gr,),
                        dimension=1, num_keys=num_keys)
         top_inv = out[0][:, :k]
         valid_k = top_inv == 0
         top_limbs = [jnp.where(valid_k, out[1 + l][:, :k],
                                jnp.uint32(0xFFFFFFFF))
-                     for l in range(N_LIMBS)]
-        top_idx = jnp.where(valid_k, out[6][:, :k], -1)
-        top_dist = jnp.stack(top_limbs, axis=-1)           # single 3-D build
+                     for l in range(nd)]
+        top_idx = jnp.where(valid_k, out[1 + nd][:, :k], -1)
+        top_dist = (jnp.stack(top_limbs, axis=-1)          # single 3-D build
+                    if nd == N_LIMBS else None)
 
     # window certificate (same argument as window_topk, start = 64j);
     # neighbor rows came along in the gathered row — no extra gather.
-    kth_dist = jnp.stack([tl[:, k - 1] for tl in top_limbs], axis=-1)
+    if top_dist is not None:
+        kth_ids = xor_ids(queries, top_dist[:, k - 1])
+        cp_k = common_bits(queries, kth_ids)
+    else:
+        # fast2: exact cp below 64 bits, clamped (lower bound) above —
+        # conservative: a clamp can only turn certified → uncertified
+        x0 = top_limbs[0][:, k - 1]
+        x1 = top_limbs[1][:, k - 1]
+        cp_k = jnp.where(x0 != 0, clz32(x0), 32 + clz32(x1))
     certified = _window_certificate(
-        queries, kth_dist, valid_k[:, k - 1], left_ids, right_ids,
+        queries, cp_k, valid_k[:, k - 1], left_ids, right_ids,
         start > 0, (start + EXPAND_LEN) < n_valid)
 
-    if select == "fast3":
-        # fast3 exactness: no adjacent (d0, d1) tie among the first k+1
-        # valid sorted rows (a tie anywhere in the sorted order is an
-        # adjacent tie; ties past position k cannot change the top-k set
-        # or its order).
+    if select in ("fast3", "fast2"):
+        # fast3/fast2 exactness: no adjacent (d0, d1) tie among the
+        # first k+1 valid sorted rows (a tie anywhere in the sorted
+        # order is an adjacent tie; ties past position k cannot change
+        # the top-k set or its order).
         a0 = out[1][:, :k + 1]
         a1 = out[2][:, :k + 1]
         av = out[0][:, :k + 1] == 0
@@ -447,6 +482,7 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     bad = jnp.nonzero(~cert)[0]
     valid_rows = jnp.arange(sorted_ids.shape[0]) < n_valid
     fb_dist, fb_idx = xor_topk(queries[bad], sorted_ids, k=k, valid=valid_rows)
-    dist = dist.at[bad].set(fb_dist)
+    if dist is not None:                      # fast2 returns no distances
+        dist = dist.at[bad].set(fb_dist)
     idx = idx.at[bad].set(fb_idx)
     return dist, idx, jnp.ones_like(cert)

@@ -352,3 +352,43 @@ def test_expanded_topk_small_tables(select):
             want = _oracle_topk(q_raw[qi], table_raw, 8, valid)
             got = [p[j] for j in idx[qi] if j >= 0]
             assert got == [w[1] for w in want], (n, nv, qi)
+
+
+def test_expanded_fast2_idx_exact():
+    """fast2 carries no distance limbs; the index set/order must still be
+    exact where certified, ties must decertify, and lookup_topk's
+    fallback must repair the rest."""
+    from opendht_tpu.ops.sorted_table import expanded_topk
+    table_raw = _rand_raw(4096, 60)
+    q_raw = _rand_raw(64, 61)
+    q_raw[1] = table_raw[5]
+    valid = np.ones(4096, bool)
+    valid[::5] = False
+    sorted_ids, perm, n_valid, lut, T2 = _expanded_setup(table_raw, valid)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    dist, idx, cert = expanded_topk(sorted_ids, T2, n_valid, q, k=8,
+                                    select="fast2", lut=lut)
+    assert dist is None
+    cert = np.asarray(cert)
+    assert cert.mean() > 0.9
+    p = np.asarray(perm)
+    for qi in range(64):
+        if not cert[qi]:
+            continue
+        want = _oracle_topk(q_raw[qi], table_raw, 8, valid)
+        got = [p[j] for j in np.asarray(idx[qi]) if j >= 0]
+        assert got == [w[1] for w in want], f"query {qi}"
+    # tie cluster → decertify + fallback repairs
+    table_raw2 = _rand_raw(512, 62)
+    table_raw2[:16, :8] = table_raw2[0, :8]
+    q2_raw = table_raw2[:4].copy(); q2_raw[:, 12] ^= 0x55
+    s2, p2, nv2, lut2, T22 = _expanded_setup(table_raw2)
+    q2 = jnp.asarray(K.ids_from_bytes(q2_raw))
+    d2, i2, c2 = lookup_topk(s2, nv2, q2, k=8, lut=lut2, expanded=T22,
+                             select="fast2")
+    assert d2 is None and bool(np.asarray(c2).all())
+    pp = np.asarray(p2)
+    for qi in range(4):
+        want = _oracle_topk(q2_raw[qi], table_raw2, 8)
+        got = [pp[j] for j in np.asarray(i2[qi]) if j >= 0]
+        assert got == [w[1] for w in want], f"tie query {qi}"
