@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from ..infohash import InfoHash
 from ..ops import ids as IK
 from ..ops import radix
-from ..ops.sorted_table import sort_table, lookup_topk
+from ..ops.sorted_table import sort_table, lookup_topk, expand_table
 
 # liveness windows (reference include/opendht/node.h:148-158)
 NODE_GOOD_TIME = 120 * 60.0       # replied within 2 h → good
@@ -73,13 +73,27 @@ class Snapshot:
         self.n_valid = n_valid            # int32 scalar
         self.version = version
         self.mask_key = mask_key
+        self._expanded = None             # lazy expand_table
 
     def lookup(self, queries, *, k: int = TARGET_NODES, window: int = 128):
         """Batched exact k-closest.  queries: uint32 [Q,5] (device or np).
-        Returns (rows [Q,k] int32 numpy, dist [Q,k,5] numpy) with -1 padding."""
+        Returns (rows [Q,k] int32 numpy, dist [Q,k,5] numpy) with -1 padding.
+
+        Uses the expanded row-gather fast path (built lazily per
+        snapshot — the table is immutable until the next version) with
+        the default fast3 select, which carries all five distance limbs.
+        The candidate window is fixed at EXPAND_LEN=192 rows (the
+        ``window`` arg only caps the fallback path); uncertified queries
+        fall back to the exact full scan inside lookup_topk.  No prefix
+        LUT here: routing-table ids cluster around self_id by design, so
+        LUT buckets degenerate — the plain log2(cap)-step positioning
+        search is both exact and cheap at routing-table sizes."""
         q = jnp.asarray(queries, jnp.uint32)
         w = max(k, min(window, int(self.sorted_ids.shape[0])))
-        dist, idx, _ = lookup_topk(self.sorted_ids, self.n_valid, q, k=k, window=w)
+        if self._expanded is None:
+            self._expanded = expand_table(self.sorted_ids)
+        dist, idx, _ = lookup_topk(self.sorted_ids, self.n_valid, q, k=k,
+                                   window=w, expanded=self._expanded)
         idx = np.asarray(idx)
         rows = np.where(idx >= 0, np.asarray(self.perm)[np.clip(idx, 0, None)], -1)
         return rows.astype(np.int32), np.asarray(dist)
