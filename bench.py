@@ -172,7 +172,12 @@ def measure() -> dict:
                       lut=lut))
     d_ref, i_ref = xor_topk(queries[:256], sorted_ids, k=K,
                             valid=jnp.arange(N) < n_valid)
-    exact = bool(np.array_equal(np.asarray(i2[:256]), np.asarray(i_ref))
+    # fast2 rows are only exact where certified (uncertified rows are
+    # repaired by lookup_topk's fallback — that is the stated contract);
+    # comparing uncertified rows here would flag a spurious inexactness
+    c256 = np.asarray(cert[:256])
+    exact = bool(np.array_equal(np.asarray(i2[:256])[c256],
+                                np.asarray(i_ref)[c256])
                  and np.array_equal(np.asarray(i3), np.asarray(i_ref))
                  and np.array_equal(np.asarray(d3), np.asarray(d_ref)))
 

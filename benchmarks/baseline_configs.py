@@ -200,6 +200,7 @@ def config5() -> dict:
     import jax
     import jax.numpy as jnp
     from bench import chain_slope
+    from opendht_tpu.ops.sorted_table import default_lut_bits
     from opendht_tpu.parallel import (make_mesh, sharded_sort_table,
                                       sharded_expand_table,
                                       sharded_window_lookup)

@@ -22,3 +22,18 @@ jax.config.update("jax_platforms", "cpu")   # env var alone loses to sitecustomi
 import bench
 bench.main()
 PY
+# CPU-scaled smoke of the BASELINE config drivers — catches driver-level
+# errors (e.g. a NameError in one config) that unit tests cannot see.
+# config2 is skipped: it delegates to bench.measure(), which the step
+# above already ran.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib
+spec = importlib.util.spec_from_file_location(
+    "baseline_configs", pathlib.Path("benchmarks/baseline_configs.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+for c in (1, 3, 4, 5):
+    m.main(["-c", str(c)])
+PY

@@ -41,6 +41,7 @@ hash, so runs are reproducible and shardable.
 from __future__ import annotations
 
 import functools
+import math
 
 import numpy as np
 import jax
@@ -49,7 +50,8 @@ from jax import lax
 
 from ..ops.ids import N_LIMBS, ID_BITS, ids_to_bytes, clz32
 from ..ops.radix import _PREFIX_MASKS
-from ..ops.sorted_table import _lower_bound, build_prefix_lut, default_lut_bits
+from ..ops.sorted_table import (_lower_bound, _lut_bits, build_prefix_lut,
+                                default_lut_bits, lut_budget_steps)
 
 _U32 = jnp.uint32
 
@@ -80,108 +82,107 @@ def _increment(ids):
     return jnp.stack(out[::-1], axis=-1)
 
 
-def _prefix_block_bounds(sorted_ids, n, targets, prefix_len, lut=None):
+def _prefix_block_bounds(lower, n, targets, prefix_len):
     """[lo, ub) sorted-index range of ids sharing `prefix_len` leading bits
-    with each target.  targets [..., 5]; prefix_len [...] int32."""
+    with each target.  ``lower``: flat [M,5] → [M] lower-bound positions;
+    targets [..., 5]; prefix_len [...] int32."""
     masks = jnp.take(jnp.asarray(_PREFIX_MASKS),
                      jnp.clip(prefix_len, 0, ID_BITS), axis=0)
     p_lo = targets & masks
     p_hi = p_lo | ~masks
-    flat_lo = p_lo.reshape(-1, N_LIMBS)
-    flat_hi = _increment(p_hi).reshape(-1, N_LIMBS)
-    lo = _lower_bound(sorted_ids, flat_lo, n, lut=lut,
-                      lut_steps=None).reshape(targets.shape[:-1])
-    ub = _lower_bound(sorted_ids, flat_hi, n, lut=lut,
-                      lut_steps=None).reshape(targets.shape[:-1])
+    lo = lower(p_lo.reshape(-1, N_LIMBS)).reshape(targets.shape[:-1])
+    ub = lower(_increment(p_hi).reshape(-1, N_LIMBS)
+               ).reshape(targets.shape[:-1])
     # p_hi of all-ones wraps to zero on increment → block extends to n
     wrapped = jnp.all(_increment(p_hi) == 0, axis=-1)
     ub = jnp.where(wrapped, n, ub)
     return lo, ub
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "alpha", "search_nodes", "max_hops"),
-)
-def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
-                     k: int = TARGET_NODES, alpha: int = ALPHA,
-                     search_nodes: int = SEARCH_NODES, max_hops: int = 48,
-                     lut=None):
-    """Run Q iterative lookups to convergence against an N-node network.
+def _guarded_lower_bound(sorted_ids, n, lut):
+    """Positioning closure: LUT-started bounded search when every LUT
+    bucket fits the in-bucket step budget, else the full-depth binary
+    search — decided ON DEVICE with one ``lax.cond`` per call site.
 
-    Args:
-      sorted_ids: uint32 [N, 5], lexicographically sorted network ids
-                  (node identity == sorted row index).
-      n_valid:    number of real rows in sorted_ids.
-      targets:    uint32 [Q, 5] lookup keys.
-
-    Returns dict of:
-      nodes     [Q, k] int32  — the k closest nodes found (sorted rows)
-      dist      [Q, k, 5]     — their XOR distances
-      hops      [Q] int32     — rounds until the first-k set had replied
-      converged [Q] bool
+    The bounded LUT search is silently wrong when a bucket holds more
+    than 2^steps rows (possible only on clustered/adversarial id
+    distributions); there is no exactness certificate inside the search
+    simulation to catch it, so the guard makes the LUT path *sound*
+    rather than merely fast: ``max(diff(lut))`` bounds every bucket, and
+    oversized tables simply pay the log2(N)-step search.
     """
-    N = sorted_ids.shape[0]
+    # same budget _lower_bound will actually use (ONE shared definition)
+    steps = lut_budget_steps(sorted_ids.shape[0], _lut_bits(lut))
+    # a B-row bucket needs ceil(log2 B)+1 search steps; with `steps`
+    # available, buckets up to 2^(steps-1) rows are provably covered
+    lut_ok = jnp.max(lut[1:] - lut[:-1]) <= jnp.int32(
+        1 << min(steps - 1, 30))
+
+    def lower(flat):
+        return lax.cond(
+            lut_ok,
+            lambda q: _lower_bound(sorted_ids, q, n, lut=lut,
+                                   lut_steps=None),
+            lambda q: _lower_bound(sorted_ids, q, n),
+            flat)
+    return lower
+
+
+def _common_bits_planar(a_l, b_l):
+    """commonBits over limb-plane lists (same math as ids.common_bits)."""
+    out = jnp.full(a_l[0].shape, ID_BITS, dtype=jnp.int32)
+    prev_zero = jnp.ones(a_l[0].shape, dtype=bool)
+    for i in range(N_LIMBS):
+        xi = a_l[i] ^ b_l[i]
+        is_first = prev_zero & (xi != 0)
+        out = jnp.where(is_first, 32 * i + clz32(xi), out)
+        prev_zero = prev_zero & (xi == 0)
+    return out
+
+
+def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
+                   seed_u, *, k, alpha, search_nodes, max_hops):
+    """The iterative-lookup state machine, abstracted over table access.
+
+    ALL access to the (possibly distributed) sorted node table flows
+    through two injected primitives, which is what lets the same engine
+    run single-device (:func:`simulate_lookups`) and with the table
+    row-sharded over a mesh axis (parallel/sharded.py:
+    ``tp_simulate_lookups`` — each primitive becomes a shard-local
+    partial computation + one ``psum`` over the table axis):
+
+      gather_planar(rows [...]) -> 5×[...] uint32 limb planes of the
+          globally-sorted table rows (callers pre-clip to [0, n));
+          entries for out-of-range rows may be garbage — every caller
+          masks them.
+      lower(flat [M, 5]) -> [M] int32 global lower-bound positions.
+
+    ``q_index``/``q_total`` are each query's GLOBAL index and the global
+    batch size — the deterministic reply hash is seeded by global query
+    identity, so a sharded run is bit-identical to the unsharded one.
+    """
     Q = targets.shape[0]
     S = search_nodes
     R = alpha * k            # reply entries merged per round
-    n = jnp.asarray(n_valid, jnp.int32)
-    seed_u = jnp.asarray(seed, dtype=jnp.int32).astype(_U32)
 
-    # Layout note (measured on v5e): any [.., .., 5] intermediate pads
-    # its 5-lane minor dim to 128 in TPU tiled layout (25× physical
-    # traffic — ~2.7 GB per materialized [Q, S+R, 5] at Q=131072), and
-    # per-element row gathers run issue-bound at ~190K rows/ms.  So the
-    # loop state keeps distances as 5 separate [Q, S] limb planes, id
-    # gathers go through the transposed [5, N] table (planar output,
-    # no lane padding), and the positioning searches use the prefix LUT
-    # (exact for any non-adversarial table: the in-bucket depth covers
-    # 64× the expected bucket size; the model stays deterministic
-    # either way).
-    sorted_t = sorted_ids.T                            # [5, N] one transpose
-    if lut is None:
-        # callers with a stable table should build this once with
-        # build_prefix_lut and pass it in — rebuilt here it costs a
-        # device searchsorted over N keys on every invocation
-        lut = build_prefix_lut(sorted_ids, n, bits=default_lut_bits(N))
-
-    def gather_planar(rows):
-        """rows [...] int32 → list of 5 limb arrays shaped like rows."""
-        cl = jnp.clip(rows, 0, N - 1).reshape(-1)
-        g = jnp.take(sorted_t, cl, axis=1)             # [5, M]
-        return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
-
-    def common_bits_planar(a_l, b_l):
-        """commonBits over limb-plane lists (same math as ids.common_bits)."""
-        out = jnp.full(a_l[0].shape, ID_BITS, dtype=jnp.int32)
-        prev_zero = jnp.ones(a_l[0].shape, dtype=bool)
-        for i in range(N_LIMBS):
-            xi = a_l[i] ^ b_l[i]
-            is_first = prev_zero & (xi != 0)
-            out = jnp.where(is_first, 32 * i + clz32(xi), out)
-            prev_zero = prev_zero & (xi == 0)
-        return out
-
-    pos_t = _lower_bound(sorted_ids, targets, n, lut=lut,
-                         lut_steps=None)               # [Q], fallback replies
+    pos_t = lower(targets)                             # [Q], fallback replies
 
     def reply_gather(x_rows, round_no):
         """Simulated answers of the α queried nodes per search.
         x_rows [Q, alpha] int32 (−1 = no request) → node rows [Q, R]."""
         x_l = gather_planar(x_rows)                                  # 5×[Q,a]
         t_l = [targets[:, l:l + 1] for l in range(N_LIMBS)]
-        b = common_bits_planar(x_l, t_l)                             # [Q,a]
+        b = _common_bits_planar(x_l, t_l)                            # [Q,a]
         prefix_len = jnp.clip(b + 1, 0, ID_BITS)
-        lo, ub = _prefix_block_bounds(sorted_ids, n, targets[:, None, :]
-                                      .repeat(x_rows.shape[1], 1), prefix_len,
-                                      lut=lut)
+        lo, ub = _prefix_block_bounds(lower, n, targets[:, None, :]
+                                      .repeat(x_rows.shape[1], 1), prefix_len)
         size = jnp.maximum(ub - lo, 0)                                     # [Q,a]
 
-        qi = jnp.arange(Q, dtype=_U32)[:, None, None]
+        qi = q_index.astype(_U32)[:, None, None]       # GLOBAL query ids
         ai = jnp.arange(x_rows.shape[1], dtype=_U32)[None, :, None]
         ji = jnp.arange(k, dtype=_U32)[None, None, :]
-        ctr = (((round_no.astype(_U32) * _U32(Q) + qi) * _U32(alpha) + ai)
-               * _U32(k) + ji) ^ seed_u
+        ctr = (((round_no.astype(_U32) * _U32(q_total) + qi) * _U32(alpha)
+                + ai) * _U32(k) + ji) ^ seed_u
         h = _mix32(ctr)                                                     # [Q,a,k]
 
         blk = lo[..., None] + (h % jnp.maximum(size[..., None], 1).astype(_U32)
@@ -247,7 +248,7 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     boot = jnp.full((Q, alpha), -1, jnp.int32).at[:, 0].set(
         jnp.where(
             empty, -1,
-            (_mix32(jnp.arange(Q, dtype=_U32) ^ seed_u)
+            (_mix32(q_index.astype(_U32) ^ seed_u)
              % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32)))
     cand_node = jnp.full((Q, S), -1, jnp.int32)
     cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(N_LIMBS)]
@@ -312,6 +313,70 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
         "hops": hops,
         "converged": synced(cand_node, queried) & ~empty,
     }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "alpha", "search_nodes", "max_hops"),
+)
+def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
+                     k: int = TARGET_NODES, alpha: int = ALPHA,
+                     search_nodes: int = SEARCH_NODES, max_hops: int = 48,
+                     lut=None):
+    """Run Q iterative lookups to convergence against an N-node network.
+
+    Args:
+      sorted_ids: uint32 [N, 5], lexicographically sorted network ids
+                  (node identity == sorted row index).
+      n_valid:    number of real rows in sorted_ids.
+      targets:    uint32 [Q, 5] lookup keys.
+
+    Returns dict of:
+      nodes     [Q, k] int32  — the k closest nodes found (sorted rows)
+      dist      [Q, k, 5]     — their XOR distances
+      hops      [Q] int32     — rounds until the first-k set had replied
+      converged [Q] bool
+
+    Single-device instantiation of :func:`_lookup_engine`.  The
+    table-sharded multi-chip form (table rows partitioned over a mesh
+    axis, exceeding one chip's HBM) is
+    ``parallel.tp_simulate_lookups`` — same engine, same results.
+    """
+    N = sorted_ids.shape[0]
+    Q = targets.shape[0]
+    n = jnp.asarray(n_valid, jnp.int32)
+    seed_u = jnp.asarray(seed, dtype=jnp.int32).astype(_U32)
+
+    # Layout note (measured on v5e): any [.., .., 5] intermediate pads
+    # its 5-lane minor dim to 128 in TPU tiled layout (25× physical
+    # traffic — ~2.7 GB per materialized [Q, S+R, 5] at Q=131072), and
+    # per-element row gathers run issue-bound at ~190K rows/ms.  So the
+    # loop state keeps distances as 5 separate [Q, S] limb planes, id
+    # gathers go through the transposed [5, N] table (planar output,
+    # no lane padding), and the positioning searches use the prefix LUT
+    # behind a device-side soundness guard (_guarded_lower_bound):
+    # clustered tables whose largest bucket exceeds the bounded
+    # in-bucket budget take the full-depth search instead.
+    sorted_t = sorted_ids.T                            # [5, N] one transpose
+    if lut is None:
+        # callers with a stable table should build this once with
+        # build_prefix_lut and pass it in — rebuilt here it costs a
+        # device searchsorted over N keys on every invocation
+        lut = build_prefix_lut(sorted_ids, n, bits=default_lut_bits(N))
+    # sound positioning: LUT fast path only when every bucket fits the
+    # bounded in-bucket budget, else full-depth search (lax.cond)
+    lower = _guarded_lower_bound(sorted_ids, n, lut)
+
+    def gather_planar(rows):
+        """rows [...] int32 → list of 5 limb arrays shaped like rows."""
+        cl = jnp.clip(rows, 0, N - 1).reshape(-1)
+        g = jnp.take(sorted_t, cl, axis=1)             # [5, M]
+        return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
+
+    return _lookup_engine(gather_planar, lower, n, targets,
+                          jnp.arange(Q, dtype=jnp.int32), Q, seed_u,
+                          k=k, alpha=alpha, search_nodes=search_nodes,
+                          max_hops=max_hops)
 
 
 # ---------------------------------------------------------------------------

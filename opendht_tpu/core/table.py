@@ -82,18 +82,18 @@ class Snapshot:
         Uses the expanded row-gather fast path (built lazily per
         snapshot — the table is immutable until the next version) with
         the default fast3 select, which carries all five distance limbs.
-        The candidate window is fixed at EXPAND_LEN=192 rows (the
-        ``window`` arg only caps the fallback path); uncertified queries
-        fall back to the exact full scan inside lookup_topk.  No prefix
-        LUT here: routing-table ids cluster around self_id by design, so
-        LUT buckets degenerate — the plain log2(cap)-step positioning
+        ``window`` is accepted for API symmetry with the non-expanded
+        path but IGNORED here: the candidate window is fixed at
+        EXPAND_LEN=192 rows, and uncertified queries fall back to the
+        exact full scan on device inside lookup_topk.  No prefix LUT:
+        routing-table ids cluster around self_id by design, so LUT
+        buckets degenerate — the plain log2(cap)-step positioning
         search is both exact and cheap at routing-table sizes."""
         q = jnp.asarray(queries, jnp.uint32)
-        w = max(k, min(window, int(self.sorted_ids.shape[0])))
         if self._expanded is None:
             self._expanded = expand_table(self.sorted_ids)
         dist, idx, _ = lookup_topk(self.sorted_ids, self.n_valid, q, k=k,
-                                   window=w, expanded=self._expanded)
+                                   expanded=self._expanded)
         idx = np.asarray(idx)
         rows = np.where(idx >= 0, np.asarray(self.perm)[np.clip(idx, 0, None)], -1)
         return rows.astype(np.int32), np.asarray(dist)

@@ -122,6 +122,15 @@ def _lut_bits(lut) -> int:
     return (lut.shape[0] - 1).bit_length() - 1
 
 
+def lut_budget_steps(n_rows: int, bits: int) -> int:
+    """In-bucket binary-search depth used when ``lut_steps=None``:
+    covers buckets up to 64× the expected N/2^bits size.  THE single
+    definition — the soundness guard in core/search.py
+    (``_guarded_lower_bound``) certifies the LUT path against exactly
+    this budget, so the two must never diverge."""
+    return max(6, math.ceil(math.log2(max(n_rows, 2))) - bits + 6)
+
+
 def _lower_bound(sorted_ids, queries, n_valid, lut=None,
                  lut_steps: int = LUT_BUCKET_STEPS):
     """First index i in [0, n_valid] with sorted_ids[i] >= q, batched.
@@ -139,9 +148,8 @@ def _lower_bound(sorted_ids, queries, n_valid, lut=None,
         lo = jnp.take(lut, p)
         hi = jnp.take(lut, p + 1)
         if lut_steps is None:
-            # cover buckets up to 2^6 × the expected N/2^bits size;
             # larger (adversarial) buckets merely fail the certificate
-            lut_steps = max(6, math.ceil(math.log2(max(N, 2))) - bits + 6)
+            lut_steps = lut_budget_steps(N, bits)
         steps = lut_steps
     else:
         steps = max(1, math.ceil(math.log2(max(N, 2))) + 1)
@@ -448,10 +456,52 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     return top_dist, top_idx, certified
 
 
+@functools.partial(jax.jit, static_argnames=("k", "window", "select",
+                                             "lut_steps", "tile"))
+def _lookup_topk_device(sorted_ids, expanded, n_valid, queries, lut, *,
+                        k, window, select, lut_steps, tile):
+    """Fast lookup + device-side exact fallback in ONE device call.
+
+    ``lax.cond`` on the all-certified predicate keeps the common path
+    free of the O(N) scan (same pattern as the sharded shard-local
+    fallback, parallel/sharded.py); when any query decertifies, the
+    whole batch is rescanned and certified rows keep their window
+    result.  No host sync — the data-dependent choice stays on device.
+    """
+    if expanded is not None:
+        dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
+                                        queries, k=k, select=select,
+                                        lut=lut, lut_steps=lut_steps)
+    else:
+        dist, idx, cert = window_topk(sorted_ids, n_valid, queries, k=k,
+                                      window=window, lut=lut,
+                                      lut_steps=(LUT_BUCKET_STEPS
+                                                 if lut_steps is None
+                                                 else lut_steps))
+    valid_rows = jnp.arange(sorted_ids.shape[0]) < n_valid
+
+    def exact(_):
+        d2, i2 = xor_topk(queries, sorted_ids, k=k, tile=tile,
+                          valid=valid_rows)
+        keep = cert[:, None]
+        i_out = jnp.where(keep, idx, i2)
+        if dist is None:                      # fast2 carries no distances
+            return (i_out,)
+        return (i_out, jnp.where(keep[..., None], dist, d2))
+
+    def fast(_):
+        return (idx,) if dist is None else (idx, dist)
+
+    out = lax.cond(jnp.all(cert), fast, exact, operand=None)
+    if dist is None:
+        return None, out[0], jnp.ones_like(cert)
+    return out[1], out[0], jnp.ones_like(cert)
+
+
 def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
                 fallback: bool = True, lut=None,
                 lut_steps=None, expanded=None,
-                select: str = "fast3"):
+                select: str = "fast3", host_fallback: bool = False):
     """Window lookup with exact fallback: uncertified queries re-run
     through the full-scan oracle so the result is always exact (when
     ``fallback=True``; with ``fallback=False`` rows where the returned
@@ -460,10 +510,20 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     With ``expanded`` (from :func:`expand_table`) the fast row-gather
     path (:func:`expanded_topk`) replaces the per-element window gather.
 
-    Host-level driver (the fallback set is data-dependent); the common
-    path is a single device call.  Returns (dist [Q,k,5],
-    idx [Q,k] int32 into the *sorted* table, certified [Q] bool).
+    The default fallback is resolved ON DEVICE (``lax.cond`` exact
+    rescan) so the certified common case costs exactly one device call
+    with no host round-trip.  ``host_fallback=True`` keeps the old
+    host-driven path — it fetches the certificate and rescans only the
+    uncertified rows, which is cheaper when misses are frequent *and*
+    the batch is huge, at the price of a blocking device→host sync per
+    call.  Returns (dist [Q,k,5], idx [Q,k] int32 into the *sorted*
+    table, certified [Q] bool).
     """
+    tile = max(1, min(4096, int(sorted_ids.shape[0])))
+    if fallback and not host_fallback:
+        return _lookup_topk_device(sorted_ids, expanded, n_valid, queries,
+                                   lut, k=k, window=window, select=select,
+                                   lut_steps=lut_steps, tile=tile)
     if expanded is not None:
         dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
                                         queries, k=k, select=select,
@@ -481,7 +541,8 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
         return dist, idx, cert
     bad = jnp.nonzero(~cert)[0]
     valid_rows = jnp.arange(sorted_ids.shape[0]) < n_valid
-    fb_dist, fb_idx = xor_topk(queries[bad], sorted_ids, k=k, valid=valid_rows)
+    fb_dist, fb_idx = xor_topk(queries[bad], sorted_ids, k=k, tile=tile,
+                               valid=valid_rows)
     if dist is not None:                      # fast2 returns no distances
         dist = dist.at[bad].set(fb_dist)
     idx = idx.at[bad].set(fb_idx)

@@ -9,4 +9,5 @@ from .sharded import (  # noqa: F401
     sharded_window_lookup,
     sharded_lookup,
     dp_simulate_lookups,
+    tp_simulate_lookups,
 )

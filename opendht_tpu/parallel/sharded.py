@@ -40,8 +40,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.ids import N_LIMBS
 from ..ops.xor_topk import xor_topk, select_topk, mask_invalid
 from ..ops.sorted_table import (sort_table, window_topk, build_prefix_lut,
-                                expand_table, expanded_topk, _EROW)
-from ..core.search import simulate_lookups
+                                default_lut_bits, expand_table, expanded_topk,
+                                _EROW)
+from ..core.search import (simulate_lookups, _lookup_engine,
+                           _guarded_lower_bound, TARGET_NODES, ALPHA,
+                           SEARCH_NODES)
 
 _U32 = jnp.uint32
 
@@ -277,6 +280,108 @@ def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
                                  k=k, window=window)
 
 
+@functools.lru_cache(maxsize=16)
+def _build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
+                     alpha: int, search_nodes: int, max_hops: int,
+                     lut_bits: int):
+    """Compile the table-sharded iterative lookup for one geometry."""
+    q_local = q_total // mesh.shape["q"]
+
+    def local(sorted_shard, n_valid, targets_local, seed):
+        ti = lax.axis_index("t")
+        base = (ti * shard_n).astype(jnp.int32)
+        n = jnp.asarray(n_valid, jnp.int32)
+        n_local = jnp.clip(n - base, 0, shard_n)
+        lut = build_prefix_lut(sorted_shard, n_local, bits=lut_bits)
+        local_lower = _guarded_lower_bound(sorted_shard, n_local, lut)
+        sorted_t = sorted_shard.T                        # [5, shard_n]
+
+        def lower(flat):
+            # global lower bound = Σ_shards (local rows < q): each
+            # shard's local lower-bound index IS that count, and the
+            # global sorted order is the in-order concatenation of
+            # shard ranges — one [M]-int32 psum over the table axis
+            return lax.psum(local_lower(flat), "t")
+
+        def gather_planar(rows):
+            # distributed row fetch: the owning shard contributes the
+            # row's limbs, every other shard zeros — psum reassembles.
+            # Rows are pre-clipped to [0, n) by the engine; -1 (absent)
+            # rows land out of range on every shard and come back 0,
+            # masked by the engine exactly like the unsharded garbage.
+            flat = (rows - base).reshape(-1)
+            ok = (flat >= 0) & (flat < shard_n)
+            g = jnp.take(sorted_t, jnp.clip(flat, 0, shard_n - 1), axis=1)
+            g = jnp.where(ok[None, :], g, _U32(0))
+            g = lax.psum(g, "t")
+            return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
+
+        q_index = (lax.axis_index("q").astype(jnp.int32) * q_local
+                   + jnp.arange(q_local, dtype=jnp.int32))
+        return _lookup_engine(gather_planar, lower, n, targets_local,
+                              q_index, q_total, seed.astype(_U32),
+                              k=k, alpha=alpha, search_nodes=search_nodes,
+                              max_hops=max_hops)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("t", None), P(), P("q", None), P()),
+        out_specs={"nodes": P("q", None), "dist": P("q", None, None),
+                   "hops": P("q"), "converged": P("q")},
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
+                        seed: int = 0, k: int = TARGET_NODES,
+                        alpha: int = ALPHA, search_nodes: int = SEARCH_NODES,
+                        max_hops: int = 48):
+    """Iterative lookups with the sorted table ROW-SHARDED over ``t`` —
+    the multi-chip north star: tables larger than one chip's HBM are
+    searched iteratively, not just scanned.
+
+    ``sorted_ids`` must be GLOBALLY sorted (one :func:`sort_table` /
+    host sort over the whole id set); each ``t``-shard then owns one
+    contiguous range of the global sorted order, which is what makes
+    both distributed primitives one-collective cheap:
+
+    - positioning: global lower_bound = psum of per-shard local counts;
+    - row fetch: owner-shard gather + psum (zeros elsewhere).
+
+    Per hop a query moves ~(α+R)·5 u32 of id limbs and ~3·M int32 of
+    positions over ICI — O(queries), never O(table).  Search state is
+    sharded over ``q`` and replicated over ``t`` (deterministic
+    identical compute per t-rank, like the merge re-sort in
+    :func:`sharded_window_lookup`).  Results are BIT-IDENTICAL to
+    :func:`~opendht_tpu.core.search.simulate_lookups` on the same table
+    (the reply hash is seeded by global query identity) — asserted in
+    tests/test_sharded.py.
+
+    targets [Q, 5]: Q divisible by mesh.shape['q']; N divisible by
+    mesh.shape['t'].  Ref: the loop being scaled is searchStep,
+    /root/reference/src/dht.cpp:561-654.
+    """
+    N = sorted_ids.shape[0]
+    n_t = mesh.shape["t"]
+    if N % n_t:
+        raise ValueError(f"table rows ({N}) not divisible by t={n_t}; "
+                         f"pad with invalid rows via pad_to_multiple")
+    Q = targets.shape[0]
+    if Q % mesh.shape["q"]:
+        raise ValueError(f"targets ({Q}) not divisible by q axis "
+                         f"{mesh.shape['q']}")
+    shard_n = N // n_t
+    fn = _build_tp_lookup(mesh, shard_n, Q, k, alpha, search_nodes, max_hops,
+                          default_lut_bits(shard_n))
+    sorted_ids = jax.device_put(jnp.asarray(sorted_ids, _U32),
+                                NamedSharding(mesh, P("t", None)))
+    targets = jax.device_put(jnp.asarray(targets, _U32),
+                             NamedSharding(mesh, P("q", None)))
+    return fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
+              jnp.asarray(seed, jnp.int32))
+
+
 def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):
     """Data-parallel batched iterative lookups: targets sharded over the
     whole mesh (both axes), sorted table replicated.  The per-step merge
@@ -287,4 +392,9 @@ def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):
     rep = NamedSharding(mesh, P(None, None))
     targets = jax.device_put(jnp.asarray(targets, _U32), q_sharding)
     sorted_ids = jax.device_put(jnp.asarray(sorted_ids, _U32), rep)
+    if kw.get("lut") is None:
+        kw["lut"] = jax.device_put(
+            build_prefix_lut(sorted_ids, jnp.asarray(n_valid, jnp.int32),
+                             bits=default_lut_bits(sorted_ids.shape[0])),
+            NamedSharding(mesh, P(None)))
     return simulate_lookups(sorted_ids, n_valid, targets, **kw)

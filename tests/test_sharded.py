@@ -17,6 +17,7 @@ from opendht_tpu.core.search import simulate_lookups
 from opendht_tpu.parallel import (
     make_mesh, pad_to_multiple, sharded_xor_topk, sharded_lookup,
     sharded_sort_table, sharded_window_lookup, dp_simulate_lookups,
+    tp_simulate_lookups,
 )
 
 
@@ -123,6 +124,62 @@ def test_dp_simulate_matches_unsharded(mesh):
     np.testing.assert_array_equal(np.asarray(out["hops"]), np.asarray(ref["hops"]))
     np.testing.assert_array_equal(
         np.asarray(out["converged"]), np.asarray(ref["converged"]))
+
+
+def test_tp_simulate_matches_unsharded(mesh):
+    """The TABLE-SHARDED iterative lookup (sorted table P('t', None),
+    positioning and row fetch each one psum over the t axis) is bitwise
+    identical to the single-device engine — the contract that lets a
+    table larger than one chip's HBM be *searched*, not just scanned
+    (VERDICT round 2 item 1)."""
+    rng = np.random.default_rng(13)
+    ids = _rand_ids(rng, 4096)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = _rand_ids(rng, 16 * mesh.shape["q"])
+
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=5)
+    out = tp_simulate_lookups(mesh, np.asarray(sorted_ids), n_valid,
+                              targets, seed=5)
+    for key in ("nodes", "hops", "converged", "dist"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]))
+
+
+def test_tp_simulate_padded_table(mesh):
+    """Row counts not divisible by n_t are padded; padding content is
+    irrelevant by construction (rows >= n_valid are excluded from both
+    distributed primitives) — zero padding, which sorts BEFORE real ids,
+    must still give exact results."""
+    rng = np.random.default_rng(14)
+    ids = _rand_ids(rng, 1021)               # prime → real padding
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = _rand_ids(rng, 8 * mesh.shape["q"])
+
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=2)
+    padded, _ = pad_to_multiple(np.asarray(sorted_ids), mesh.shape["t"])
+    out = tp_simulate_lookups(mesh, padded, n_valid, targets, seed=2)
+    for key in ("nodes", "hops", "converged"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]))
+
+
+def test_tp_simulate_clustered_ids(mesh):
+    """Adversarially clustered ids overflow per-shard LUT buckets; the
+    device-side soundness guard must drop to the full-depth search and
+    still match the unsharded engine exactly."""
+    rng = np.random.default_rng(15)
+    ids = _rand_ids(rng, 2048)
+    ids[:1500, 0] = 0x41414141               # 73% share the top 32 bits
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = _rand_ids(rng, 8 * mesh.shape["q"])
+    targets[: 4 * mesh.shape["q"], 0] = 0x41414141   # half hit the cluster
+
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=6)
+    out = tp_simulate_lookups(mesh, np.asarray(sorted_ids), n_valid,
+                              targets, seed=6)
+    for key in ("nodes", "hops", "converged"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]))
 
 
 def test_sharded_expanded_lookup_matches_full_scan(mesh):
