@@ -1,0 +1,47 @@
+"""opendht_tpu — a TPU-native distributed hash table framework.
+
+A ground-up re-design of the capabilities of OpenDHT (reference:
+``Dale-M/opendht`` @ /root/reference, surveyed in SURVEY.md): a Kademlia
+DHT with ``get/put/listen/query`` value store, signed/encrypted values,
+write tokens, a REST proxy and a Python-first API — with the routing
+core re-architected as batched JAX/XLA kernels over HBM-resident
+node-ID matrices instead of scalar per-search loops.
+
+Package layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``ops``        L0 device kernels: 160-bit ID math, XOR top-k (lax + pallas),
+                 sorted-table window lookup, radix partition
+- ``core``       L2 data structures: node table, routing, batched search, storage, values
+- ``net``        L1 host network engine: msgpack wire protocol, request lifecycle
+- ``native``     C++ host runtime: XOR engine + UDP datagram engine (ctypes)
+- ``crypto``     L0/L3 identities, sign/encrypt (SecureDht overlay)
+- ``runtime``    L4 Dht core + DhtRunner façade + scheduler
+- ``parallel``   multi-chip sharded tables (jax.sharding Mesh + shard_map)
+- ``proxy``      REST proxy server/client
+- ``indexation`` PHT (prefix hash tree) distributed index
+- ``tools``      dhtnode / dhtchat / dhtscanner CLI equivalents
+- ``testing``    cluster harness: virtual-clock network, scenario suites, benchmark
+- ``log``        Logger with per-hash filter and console/file/syslog sinks
+"""
+
+__version__ = "0.1.0"
+
+from .infohash import InfoHash, PkId, random_infohash  # noqa: F401
+from .core.value import Value, ValueType, Query, Select, Where, Filters  # noqa: F401
+from .runtime.config import Config, NodeStats, NodeStatus, SecureDhtConfig  # noqa: F401
+from .runtime.runner import DhtRunner, RunnerConfig  # noqa: F401
+from .crypto import (  # noqa: F401
+    Certificate, Identity, PrivateKey, PublicKey, RevocationList, TrustList,
+    VerifyResult, generate_identity, generate_ec_identity,
+)
+from .sockaddr import SockAddr  # noqa: F401
+from .net.node import Node  # noqa: F401
+from .nodeset import NodeEntry, NodeSet  # noqa: F401
+from .indexation.pht import IndexEntry as IndexValue, Pht  # noqa: F401
+
+#: binding-compat aliases (↔ python/opendht.pyx names)
+DhtConfig = Config
+#: DhtRunner.listen returns this token handle (a Future resolving to the
+#: runner-level token — pass it back to cancel_listen)
+import concurrent.futures as _futures
+ListenToken = _futures.Future

@@ -1,0 +1,3 @@
+"""L2 data structures: node tables, routing semantics, batched search
+engine, value store.  Host code mutates numpy-backed slabs; batched
+queries run on device snapshots (see core/table.py for the split)."""

@@ -1,0 +1,461 @@
+"""Batched iterative Kademlia lookup engine.
+
+The reference resolves each ``get()`` with a sequential state machine:
+``Dht::searchStep`` (src/dht.cpp:561-654) keeps a sorted set of ≤ 14
+candidates per target (``Search::insertNode``, src/search.h:636-722),
+keeps α = 4 requests in flight (dht.h:321), inserts every reply's nodes
+back into the set, and is done when the first k = 8 candidates have all
+replied (``isSynced``, src/search.h:734-747).
+
+Here the *entire population of concurrent lookups* advances together:
+one device step selects the next α unqueried candidates for every one of
+Q searches, resolves all Q·α simulated replies against the global node
+matrix, and merges them back — all as fixed-shape array ops inside a
+``lax.while_loop``.  A million lookups cost a few dozen fused device
+steps instead of millions of scalar iterations.
+
+State layout (fixed shapes; "no candidate" = node index -1):
+
+    cand_node [Q, S]     int32   sorted-table index of each candidate
+    cand_l    5×[Q, S]   uint32  XOR distance limb planes (sort key;
+                                 kept planar — see layout note below)
+    queried   [Q, S]     int32   request sent
+    replied   [Q, S]     int32   reply merged
+    hops      [Q]        int32   rounds taken until convergence
+    done      [Q]        bool
+
+Simulated network model (for hop-count/convergence studies, mirroring
+the role of the reference's netns cluster harness,
+python/tools/dht/tests.py): node x, asked for target t, answers with k
+nodes drawn from the prefix block sharing ``commonBits(x, t) + 1``
+leading bits with t — exactly what x's deepest relevant k-bucket holds
+in a converged Kademlia network (every hop gains ≥ 1 prefix bit, ~3 in
+expectation with k = 8 samples).  When that block is smaller than k the
+reply is the k rows straddling t's sorted position — the closest set a
+real peer that close would answer with (model validated against the
+live protocol path at matched N, tests/test_hop_parity.py).  Replies
+are deterministic in (seed, round, search, slot) via a counter-based
+hash, so runs are reproducible and shardable.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.ids import N_LIMBS, ID_BITS, ids_to_bytes, clz32
+from ..ops.radix import _PREFIX_MASKS
+from ..ops.sorted_table import (_lower_bound, _lut_bits, build_prefix_lut,
+                                default_lut_bits, lut_budget_steps)
+
+_U32 = jnp.uint32
+
+ALPHA = 4            # in-flight requests per search (dht.h:321)
+SEARCH_NODES = 14    # candidate set size (dht.h:308)
+TARGET_NODES = 8     # convergence set (routing_table.h:26)
+
+
+def _mix32(x):
+    """Counter-based uint32 hash (splitmix-style) for reply sampling."""
+    x = x.astype(_U32)
+    x = x ^ (x >> 16)
+    x = x * _U32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * _U32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _increment(ids):
+    """160-bit +1 over [..., 5] uint32 limbs (wraps to zero)."""
+    out = []
+    carry = jnp.ones(ids.shape[:-1], dtype=_U32)
+    for i in range(N_LIMBS - 1, -1, -1):
+        s = ids[..., i] + carry
+        carry = jnp.where((s == 0) & (carry == 1), _U32(1), _U32(0))
+        out.append(s)
+    return jnp.stack(out[::-1], axis=-1)
+
+
+def _prefix_block_bounds(lower, n, targets, prefix_len):
+    """[lo, ub) sorted-index range of ids sharing `prefix_len` leading bits
+    with each target.  ``lower``: flat [M,5] → [M] lower-bound positions;
+    targets [..., 5]; prefix_len [...] int32."""
+    masks = jnp.take(jnp.asarray(_PREFIX_MASKS),
+                     jnp.clip(prefix_len, 0, ID_BITS), axis=0)
+    p_lo = targets & masks
+    p_hi = p_lo | ~masks
+    lo = lower(p_lo.reshape(-1, N_LIMBS)).reshape(targets.shape[:-1])
+    ub = lower(_increment(p_hi).reshape(-1, N_LIMBS)
+               ).reshape(targets.shape[:-1])
+    # p_hi of all-ones wraps to zero on increment → block extends to n
+    wrapped = jnp.all(_increment(p_hi) == 0, axis=-1)
+    ub = jnp.where(wrapped, n, ub)
+    return lo, ub
+
+
+def _guarded_lower_bound(sorted_ids, n, lut):
+    """Positioning closure: LUT-started bounded search when every LUT
+    bucket fits the in-bucket step budget, else the full-depth binary
+    search — decided ON DEVICE with one ``lax.cond`` per call site.
+
+    The bounded LUT search is silently wrong when a bucket holds more
+    than 2^steps rows (possible only on clustered/adversarial id
+    distributions); there is no exactness certificate inside the search
+    simulation to catch it, so the guard makes the LUT path *sound*
+    rather than merely fast: ``max(diff(lut))`` bounds every bucket, and
+    oversized tables simply pay the log2(N)-step search.
+    """
+    # same budget _lower_bound will actually use (ONE shared definition)
+    steps = lut_budget_steps(sorted_ids.shape[0], _lut_bits(lut))
+    # a B-row bucket needs ceil(log2 B)+1 search steps; with `steps`
+    # available, buckets up to 2^(steps-1) rows are provably covered
+    lut_ok = jnp.max(lut[1:] - lut[:-1]) <= jnp.int32(
+        1 << min(steps - 1, 30))
+
+    def lower(flat):
+        return lax.cond(
+            lut_ok,
+            lambda q: _lower_bound(sorted_ids, q, n, lut=lut,
+                                   lut_steps=None),
+            lambda q: _lower_bound(sorted_ids, q, n),
+            flat)
+    return lower
+
+
+def _common_bits_planar(a_l, b_l):
+    """commonBits over limb-plane lists (same math as ids.common_bits)."""
+    out = jnp.full(a_l[0].shape, ID_BITS, dtype=jnp.int32)
+    prev_zero = jnp.ones(a_l[0].shape, dtype=bool)
+    for i in range(N_LIMBS):
+        xi = a_l[i] ^ b_l[i]
+        is_first = prev_zero & (xi != 0)
+        out = jnp.where(is_first, 32 * i + clz32(xi), out)
+        prev_zero = prev_zero & (xi == 0)
+    return out
+
+
+def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
+                   seed_u, *, k, alpha, search_nodes, max_hops):
+    """The iterative-lookup state machine, abstracted over table access.
+
+    ALL access to the (possibly distributed) sorted node table flows
+    through two injected primitives, which is what lets the same engine
+    run single-device (:func:`simulate_lookups`) and with the table
+    row-sharded over a mesh axis (parallel/sharded.py:
+    ``tp_simulate_lookups`` — each primitive becomes a shard-local
+    partial computation + one ``psum`` over the table axis):
+
+      gather_planar(rows [...]) -> 5×[...] uint32 limb planes of the
+          globally-sorted table rows (callers pre-clip to [0, n));
+          entries for out-of-range rows may be garbage — every caller
+          masks them.
+      lower(flat [M, 5]) -> [M] int32 global lower-bound positions.
+
+    ``q_index``/``q_total`` are each query's GLOBAL index and the global
+    batch size — the deterministic reply hash is seeded by global query
+    identity, so a sharded run is bit-identical to the unsharded one.
+    """
+    Q = targets.shape[0]
+    S = search_nodes
+    R = alpha * k            # reply entries merged per round
+
+    pos_t = lower(targets)                             # [Q], fallback replies
+
+    def reply_gather(x_rows, round_no):
+        """Simulated answers of the α queried nodes per search.
+        x_rows [Q, alpha] int32 (−1 = no request) → node rows [Q, R]."""
+        x_l = gather_planar(x_rows)                                  # 5×[Q,a]
+        t_l = [targets[:, l:l + 1] for l in range(N_LIMBS)]
+        b = _common_bits_planar(x_l, t_l)                            # [Q,a]
+        prefix_len = jnp.clip(b + 1, 0, ID_BITS)
+        lo, ub = _prefix_block_bounds(lower, n, targets[:, None, :]
+                                      .repeat(x_rows.shape[1], 1), prefix_len)
+        size = jnp.maximum(ub - lo, 0)                                     # [Q,a]
+
+        qi = q_index.astype(_U32)[:, None, None]       # GLOBAL query ids
+        ai = jnp.arange(x_rows.shape[1], dtype=_U32)[None, :, None]
+        ji = jnp.arange(k, dtype=_U32)[None, None, :]
+        ctr = (((round_no.astype(_U32) * _U32(q_total) + qi) * _U32(alpha)
+                + ai) * _U32(k) + ji) ^ seed_u
+        h = _mix32(ctr)                                                     # [Q,a,k]
+
+        blk = lo[..., None] + (h % jnp.maximum(size[..., None], 1).astype(_U32)
+                               ).astype(jnp.int32)
+        # fallback: block too small → the peer knows the target's
+        # neighborhood and answers with rows from the (alpha·k)-wide
+        # window straddling pos_t, each queried slot contributing a
+        # distinct k-slice so one round covers the window determinist-
+        # ically (a real node replies with the closest set it knows, not
+        # a uniform sample — the round-1 uniform model overestimated
+        # terminal hops ~2x; validated against the live protocol path in
+        # tests/test_hop_parity.py)
+        base = jnp.clip(pos_t[:, None, None] - R // 2, 0,
+                        jnp.maximum(n - R, 0))
+        fb = jnp.clip(base + (ai * _U32(k) + ji).astype(jnp.int32), 0,
+                      jnp.maximum(n - 1, 0))
+        rows = jnp.where((size[..., None] >= k), blk, fb)
+        rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
+        return rows.reshape(Q, R)
+
+    def merge(cand_node, cand_l, queried, new_rows):
+        """Insert replies, dedupe by node, keep the S closest
+        (↔ Search::insertNode, src/search.h:636-722).  ``cand_l`` is the
+        candidate distance as 5 limb planes [Q, S]; everything stays 2-D."""
+        new_l = gather_planar(new_rows)                           # 5×[Q,R]
+        node = jnp.concatenate([cand_node, new_rows], axis=1)     # [Q,S+R]
+        d_l = [jnp.concatenate([cand_l[l], new_l[l] ^ targets[:, l:l + 1]],
+                               axis=1) for l in range(N_LIMBS)]
+        qd = jnp.concatenate([queried, jnp.zeros((Q, R), jnp.int32)], axis=1)
+        inv = (node < 0).astype(jnp.int32)
+        # new entries beyond the valid table (padded fallback rows for
+        # empty/absent requests) already arrive as -1 via reply_gather;
+        # their distance planes are garbage but masked by inv.
+        big = jnp.uint32(0xFFFFFFFF)
+        d_l = [jnp.where(inv == 0, dl, big) for dl in d_l]
+        # sort by (invalid, dist, node, not-queried) so that among
+        # duplicates of a node the already-queried copy comes first
+        out = lax.sort(
+            (inv, d_l[0], d_l[1], d_l[2], d_l[3], d_l[4], node, 1 - qd),
+            dimension=1, num_keys=8,
+        )
+        inv_s, node_s = out[0], out[6]
+        qd_s = 1 - out[7]
+        # dedupe: same node appears adjacently (same dist); drop repeats
+        dup = jnp.concatenate(
+            [jnp.zeros((Q, 1), bool),
+             (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)], axis=1)
+        inv2 = jnp.where(dup, 1, inv_s)
+        out2 = lax.sort(
+            (inv2, out[1], out[2], out[3], out[4], out[5], node_s, 1 - qd_s),
+            dimension=1, num_keys=7,
+        )
+        present = out2[0][:, :S] == 0
+        node_f = jnp.where(present, out2[6][:, :S], -1)
+        d_f = [jnp.where(present, out2[1 + l][:, :S], big)
+               for l in range(N_LIMBS)]
+        qd_f = (1 - out2[7])[:, :S] * present
+        return node_f, d_f, qd_f
+
+    # -- bootstrap: cold start from ONE pseudo-random bootstrap peer per
+    # search (like a node boots from a single well-known host) ------------
+    empty = n <= 0
+    boot = jnp.full((Q, alpha), -1, jnp.int32).at[:, 0].set(
+        jnp.where(
+            empty, -1,
+            (_mix32(q_index.astype(_U32) ^ seed_u)
+             % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32)))
+    cand_node = jnp.full((Q, S), -1, jnp.int32)
+    cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(N_LIMBS)]
+    queried = jnp.zeros((Q, S), jnp.int32)
+    first = reply_gather(boot, jnp.int32(0))
+    cand_node, cand_l, queried = merge(cand_node, cand_l, queried, first)
+
+    def synced(cand_node, queried):
+        """First min(k, #candidates) candidates all answered
+        (↔ isSynced, search.h:734-747).  Replies are instantaneous in this
+        network model, so 'queried' doubles as 'replied'; a lossy-network
+        model would split the two flags again."""
+        present = cand_node[:, :k] >= 0
+        return jnp.all(~present | (queried[:, :k] > 0), axis=1) & \
+            jnp.any(present, axis=1)
+
+    def cond(state):
+        done, round_no = state[4], state[5]
+        return (~jnp.all(done)) & (round_no < max_hops)
+
+    def body(state):
+        cand_node, cand_l, queried, hops, done, round_no = state
+        # select the closest α unqueried candidates per active search
+        # (↔ searchSendGetValues picking SearchNodes with canGet,
+        #  src/dht.cpp:628-639)
+        can = (cand_node >= 0) & (queried == 0) & ~done[:, None]
+        rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+        sel = can & (rank <= alpha)
+        # gather selected rows into [Q, alpha] (−1 pad)
+        sel_rank = jnp.where(sel, rank - 1, S)
+        x_rows = jnp.full((Q, alpha + 1), -1, jnp.int32)
+        x_rows = x_rows.at[
+            jnp.arange(Q)[:, None].repeat(S, 1).reshape(-1),
+            jnp.minimum(sel_rank, alpha).reshape(-1),
+        ].max(jnp.where(sel, cand_node, -1).reshape(-1))
+        x_rows = x_rows[:, :alpha]
+
+        new_rows = reply_gather(x_rows, round_no + 1)
+        queried = jnp.where(sel, 1, queried)
+        cand_node, cand_l, queried = merge(
+            cand_node, cand_l, queried, new_rows)
+
+        now_done = synced(cand_node, queried)
+        stalled = ~jnp.any((cand_node >= 0) & (queried == 0), axis=1)
+        sent = jnp.any(sel, axis=1)
+        # a stalling round sends nothing → costs no hop (matches the
+        # scalar reference's stall return path)
+        hops = jnp.where(~done & sent, hops + 1, hops)
+        done = done | now_done | stalled
+        return cand_node, cand_l, queried, hops, done, round_no + 1
+
+    state = (cand_node, cand_l, queried,
+             jnp.zeros((Q,), jnp.int32),
+             synced(cand_node, queried) | empty,
+             jnp.int32(0))
+    cand_node, cand_l, queried, hops, done, _ = \
+        lax.while_loop(cond, body, state)
+
+    return {
+        "nodes": cand_node[:, :k],
+        "dist": jnp.stack([cl[:, :k] for cl in cand_l], axis=-1),
+        "hops": hops,
+        "converged": synced(cand_node, queried) & ~empty,
+    }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "alpha", "search_nodes", "max_hops"),
+)
+def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
+                     k: int = TARGET_NODES, alpha: int = ALPHA,
+                     search_nodes: int = SEARCH_NODES, max_hops: int = 48,
+                     lut=None):
+    """Run Q iterative lookups to convergence against an N-node network.
+
+    Args:
+      sorted_ids: uint32 [N, 5], lexicographically sorted network ids
+                  (node identity == sorted row index).
+      n_valid:    number of real rows in sorted_ids.
+      targets:    uint32 [Q, 5] lookup keys.
+
+    Returns dict of:
+      nodes     [Q, k] int32  — the k closest nodes found (sorted rows)
+      dist      [Q, k, 5]     — their XOR distances
+      hops      [Q] int32     — rounds until the first-k set had replied
+      converged [Q] bool
+
+    Single-device instantiation of :func:`_lookup_engine`.  The
+    table-sharded multi-chip form (table rows partitioned over a mesh
+    axis, exceeding one chip's HBM) is
+    ``parallel.tp_simulate_lookups`` — same engine, same results.
+    """
+    N = sorted_ids.shape[0]
+    Q = targets.shape[0]
+    n = jnp.asarray(n_valid, jnp.int32)
+    seed_u = jnp.asarray(seed, dtype=jnp.int32).astype(_U32)
+
+    # Layout note (measured on v5e): any [.., .., 5] intermediate pads
+    # its 5-lane minor dim to 128 in TPU tiled layout (25× physical
+    # traffic — ~2.7 GB per materialized [Q, S+R, 5] at Q=131072), and
+    # per-element row gathers run issue-bound at ~190K rows/ms.  So the
+    # loop state keeps distances as 5 separate [Q, S] limb planes, id
+    # gathers go through the transposed [5, N] table (planar output,
+    # no lane padding), and the positioning searches use the prefix LUT
+    # behind a device-side soundness guard (_guarded_lower_bound):
+    # clustered tables whose largest bucket exceeds the bounded
+    # in-bucket budget take the full-depth search instead.
+    sorted_t = sorted_ids.T                            # [5, N] one transpose
+    if lut is None:
+        # callers with a stable table should build this once with
+        # build_prefix_lut and pass it in — rebuilt here it costs a
+        # device searchsorted over N keys on every invocation
+        lut = build_prefix_lut(sorted_ids, n, bits=default_lut_bits(N))
+    # sound positioning: LUT fast path only when every bucket fits the
+    # bounded in-bucket budget, else full-depth search (lax.cond)
+    lower = _guarded_lower_bound(sorted_ids, n, lut)
+
+    def gather_planar(rows):
+        """rows [...] int32 → list of 5 limb arrays shaped like rows."""
+        cl = jnp.clip(rows, 0, N - 1).reshape(-1)
+        g = jnp.take(sorted_t, cl, axis=1)             # [5, M]
+        return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
+
+    return _lookup_engine(gather_planar, lower, n, targets,
+                          jnp.arange(Q, dtype=jnp.int32), Q, seed_u,
+                          k=k, alpha=alpha, search_nodes=search_nodes,
+                          max_hops=max_hops)
+
+
+# ---------------------------------------------------------------------------
+# Scalar reference implementation (oracle for hop-count parity and the CPU
+# baseline) — same network model, sequential python, one lookup at a time,
+# mirroring the shape of the reference's searchStep loop.
+# ---------------------------------------------------------------------------
+
+def scalar_lookup(sorted_ids_np: np.ndarray, n: int, target_np: np.ndarray,
+                  *, seed: int = 0, k: int = TARGET_NODES, alpha: int = ALPHA,
+                  search_nodes: int = SEARCH_NODES, max_hops: int = 48,
+                  rng=None):
+    """Sequential lookup with the same candidate-set/α/convergence
+    semantics and the same network reply model as simulate_lookups (reply
+    sampling is random rather than counter-hashed, so parity is
+    statistical, not bitwise).  Returns (nodes, hops, converged)."""
+    if rng is None:
+        rng = np.random.default_rng(seed)
+
+    def row_int(i):
+        return int.from_bytes(ids_to_bytes(sorted_ids_np[i]).tobytes(), "big")
+
+    t_int = int.from_bytes(ids_to_bytes(target_np).tobytes(), "big")
+
+    def lower_bound(v: int) -> int:
+        lo, hi = 0, n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if row_int(mid) < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    pos_t = lower_bound(t_int)
+
+    def reply(x_row: int) -> list:
+        x_int = row_int(x_row)
+        cb = 160 - (x_int ^ t_int).bit_length() if x_int != t_int else 160
+        plen = min(cb + 1, 160)
+        mask = ((1 << plen) - 1) << (160 - plen) if plen else 0
+        p_lo = t_int & mask
+        p_hi = p_lo | ((1 << (160 - plen)) - 1)
+        lo = lower_bound(p_lo)
+        ub = lower_bound(p_hi + 1)
+        size = ub - lo
+        if size >= k:
+            return [lo + int(v) for v in rng.integers(0, size, k)]
+        R = alpha * k
+        base = min(max(pos_t - R // 2, 0), max(n - R, 0))
+        j = int(rng.integers(0, alpha))          # this peer's window slice
+        return [min(base + j * k + jj, n - 1) for jj in range(k)]
+
+    # candidate set: list of (dist, row, queried, replied)
+    cands: dict[int, list] = {}
+
+    def insert(row):
+        if row in cands:
+            return
+        cands[row] = [row_int(row) ^ t_int, row, False, False]
+
+    boot = int(rng.integers(0, n))
+    for r in reply(boot):
+        insert(r)
+
+    hops = 0
+    while hops < max_hops:
+        ordered = sorted(cands.values())[:search_nodes]
+        cands = {c[1]: c for c in ordered}
+        topk = ordered[:k]
+        if topk and all(c[3] for c in topk):
+            return [c[1] for c in topk], hops, True
+        to_query = [c for c in ordered if not c[2]][:alpha]
+        if not to_query:
+            return [c[1] for c in topk], hops, False
+        hops += 1
+        for c in to_query:
+            c[2] = c[3] = True
+            for r in reply(c[1]):
+                insert(r)
+    ordered = sorted(cands.values())[:k]
+    return [c[1] for c in ordered], hops, False

@@ -1,0 +1,115 @@
+"""In-flight RPC state machine (reference src/request.h).
+
+PENDING → COMPLETED (reply matched by tid) | EXPIRED (3 attempts × 1 s
+timed out) | CANCELLED.  ``on_expired(req, done)`` fires once with
+done=False after the first re-attempt (early hint used to solicit other
+candidates) and once with done=True on final expiry."""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Callable, Optional
+
+from .node import MAX_RESPONSE_TIME, Node
+
+if TYPE_CHECKING:
+    from .parsed_message import MessageType, ParsedMessage
+
+MAX_ATTEMPT_COUNT = 3           # request.h:108
+
+_NEVER = float("-inf")
+
+
+class RequestState(enum.Enum):
+    PENDING = "pending"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+    COMPLETED = "completed"
+
+
+class Request:
+    __slots__ = ("node", "tid", "type", "msg", "on_done", "on_expired",
+                 "socket_id", "state", "attempt_count", "start", "last_try",
+                 "reply_time")
+
+    def __init__(self, msg_type: "MessageType", tid: int, node: Node,
+                 msg: bytes,
+                 on_done: Optional[Callable[["Request", "ParsedMessage"], None]],
+                 on_expired: Optional[Callable[["Request", bool], None]],
+                 socket_id: int = 0):
+        self.node = node
+        self.tid = tid
+        self.type = msg_type
+        self.msg = msg
+        self.on_done = on_done
+        self.on_expired = on_expired
+        self.socket_id = socket_id
+        self.state = RequestState.PENDING
+        self.attempt_count = 0
+        self.start = _NEVER
+        self.last_try = _NEVER
+        self.reply_time = _NEVER
+
+    # -- state predicates --------------------------------------------------
+    @property
+    def pending(self) -> bool:
+        return self.state is RequestState.PENDING
+
+    @property
+    def completed(self) -> bool:
+        return self.state is RequestState.COMPLETED
+
+    @property
+    def expired(self) -> bool:
+        return self.state is RequestState.EXPIRED
+
+    @property
+    def cancelled(self) -> bool:
+        return self.state is RequestState.CANCELLED
+
+    @property
+    def over(self) -> bool:
+        return not self.pending
+
+    def is_expired(self, now: float) -> bool:
+        """All attempts used and the last one timed out (request.h:110-112).
+        ``>=``, not ``>``: retries are scheduled at exactly
+        last_try + MAX_RESPONSE_TIME, and discrete-event drivers land on
+        that instant — strict compare would retry dead nodes forever."""
+        return (self.pending
+                and now >= self.last_try + MAX_RESPONSE_TIME
+                and self.attempt_count >= MAX_ATTEMPT_COUNT)
+
+    # -- transitions (request.h:88-105) ------------------------------------
+    def set_expired(self) -> None:
+        if self.pending:
+            self.state = RequestState.EXPIRED
+            if self.on_expired:
+                self.on_expired(self, True)
+            self._clear()
+
+    def set_done(self, msg: "ParsedMessage") -> None:
+        if self.pending:
+            self.state = RequestState.COMPLETED
+            if self.on_done:
+                self.on_done(self, msg)
+            self._clear()
+
+    def cancel(self) -> None:
+        if self.pending:
+            self.state = RequestState.CANCELLED
+            self._clear()
+
+    def close_socket(self) -> int:
+        sid = self.socket_id
+        self.socket_id = 0
+        return sid
+
+    def _clear(self) -> None:
+        self.on_done = None
+        self.on_expired = None
+        self.msg = b""
+
+    def state_char(self) -> str:
+        return {"pending": "f", "cancelled": "c", "expired": "e",
+                "completed": "a"}[self.state.value]

@@ -1,0 +1,24 @@
+"""L0 device kernels: vectorized 160-bit ID math, XOR-distance top-k,
+radix/prefix partitioning.  All functions are pure, jit-friendly, and
+operate on uint32 limb matrices (see :mod:`opendht_tpu.ops.ids`)."""
+
+from .ids import (  # noqa: F401
+    N_LIMBS,
+    ID_BITS,
+    ids_from_bytes,
+    ids_to_bytes,
+    ids_from_hashes,
+    xor_ids,
+    lex_lt,
+    lex_eq,
+    lex_cmp,
+    xor_cmp,
+    common_bits,
+    lowbit,
+    get_bit,
+    set_bit,
+    clz32,
+    ctz32,
+    popcount32,
+    random_ids,
+)

@@ -1,0 +1,125 @@
+"""Pallas TPU kernel: exact lexicographic XOR-distance top-k selection.
+
+The hot op of the framework (SURVEY.md §7: the batched replacement for
+``RoutingTable::findClosestNodes`` / ``NodeCache::getCachedNodes``,
+reference src/routing_table.cpp:109-150, src/node_cache.cpp:41-74) has
+two stages: compute 160-bit XOR distances, then select the k smallest
+under **bytewise lexicographic order** (``InfoHash::xorCmp``,
+include/opendht/infohash.h:179-194).  The jnp path does the selection
+with a 7-key ``lax.sort`` (ops/xor_topk.py) — a bitonic network of
+O(W log² W) limb compares per query row.
+
+This kernel replaces the sort with **iterative lexicographic
+min-extraction** in VMEM: per extracted rank, five masked VPU min
+reductions narrow the candidate mask limb by limb (ties broken by
+smallest window position), then the winner is retired from the alive
+mask.  Cost is O(k · 5 · W) element ops of pure VPU work per query row —
+no sorting network, no MXU, no data-dependent shapes — and the
+selection is exact by construction (full 5-limb order, deterministic
+tie-break), so it needs no fallback certificate.
+
+Layout (TPU tiling: last dim 128 lanes):
+
+- distances arrive as 5 separate ``[Q, W]`` uint32 limb planes (not
+  ``[Q, W, 5]`` — a last dim of 5 would break lane alignment),
+- invalid rows as an int32 ``[Q, W]`` plane (nonzero = skip),
+- output is an int32 ``[Q, 128]`` plane whose first k lanes hold the
+  selected window positions (−1 where fewer than k valid rows exist);
+  the caller slices ``[:, :k]``.
+
+Grid: 1-D over query tiles of QT rows; each program owns its rows
+end-to-end, so there is no cross-program reduction.  On CPU the same
+kernel runs under ``interpret=True`` (tests, and the virtual-net tier).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .ids import N_LIMBS
+
+OUT_LANES = 128          # output plane lane width (≥ any useful k)
+
+
+def _select_kernel(d0_ref, d1_ref, d2_ref, d3_ref, d4_ref, inv_ref,
+                   out_ref, *, k: int):
+    # limb planes arrive sign-flipped int32 (Mosaic has no unsigned
+    # reductions; x ^ 0x80000000 maps unsigned order onto signed order)
+    W = d0_ref.shape[1]
+    big = jnp.int32(0x7FFFFFFF)
+    d = (d0_ref[...], d1_ref[...], d2_ref[...], d3_ref[...], d4_ref[...])
+    alive = inv_ref[...] == 0                             # [QT, W]
+    pos = lax.broadcasted_iota(jnp.int32, d[0].shape, 1)  # [QT, W]
+    lane = lax.broadcasted_iota(jnp.int32, (d[0].shape[0], OUT_LANES), 1)
+    out = jnp.full((d[0].shape[0], OUT_LANES), -1, jnp.int32)
+    for kk in range(k):
+        # narrow the candidate mask one limb at a time: after limb i the
+        # mask holds exactly the alive rows minimal on limbs 0..i
+        cand = alive
+        for i in range(N_LIMBS):
+            di = jnp.where(cand, d[i], big)
+            mi = jnp.min(di, axis=1, keepdims=True)
+            cand = cand & (d[i] == mi)
+        # deterministic tie-break: smallest window position
+        j = jnp.min(jnp.where(cand, pos, W), axis=1)      # [QT]
+        found = j < W
+        out = jnp.where((lane == kk) & found[:, None], j[:, None], out)
+        alive = alive & (pos != j[:, None])
+    out_ref[...] = out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "q_tile", "interpret"))
+def lex_topk_select(dist, invalid, *, k: int = 8, q_tile: int = 256,
+                    interpret: bool = False):
+    """Exact lexicographic top-k positions per query row.
+
+    Args:
+      dist:    uint32 [Q, W, 5] XOR distances (W ≥ k recommended).
+      invalid: int32/bool [Q, W]; nonzero rows are never selected.
+      k:       ranks to extract.
+      q_tile:  query rows per pallas program.
+      interpret: run the kernel in interpreter mode (CPU backends).
+
+    Returns:
+      idx int32 [Q, k]: window positions, −1 where < k valid rows.
+    """
+    Q, W, _ = dist.shape
+    inv = invalid.astype(jnp.int32)
+    pad_q = (-Q) % q_tile
+    if pad_q:
+        dist = jnp.concatenate(
+            [dist, jnp.zeros((pad_q, W, N_LIMBS), jnp.uint32)], axis=0)
+        inv = jnp.concatenate(
+            [inv, jnp.ones((pad_q, W), jnp.int32)], axis=0)
+    qp = dist.shape[0]
+    planes = [lax.bitcast_convert_type(
+        dist[:, :, i] ^ jnp.uint32(0x80000000), jnp.int32)
+        for i in range(N_LIMBS)]
+
+    grid = (qp // q_tile,)
+    in_spec = pl.BlockSpec((q_tile, W), lambda i: (i, 0),
+                           memory_space=pl.ANY
+                           if interpret else pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_select_kernel, k=k),
+        grid=grid,
+        in_specs=[in_spec] * (N_LIMBS + 1),
+        out_specs=pl.BlockSpec((q_tile, OUT_LANES), lambda i: (i, 0),
+                               memory_space=pl.ANY
+                               if interpret else pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((qp, OUT_LANES), jnp.int32),
+        interpret=interpret,
+    )(*planes, inv)
+    return out[:Q, :k]
+
+
+# Backend dispatch lives at the call site (ops/sorted_table.window_topk
+# selects pallas-vs-sort and compiled-vs-interpret by backend); this
+# module stays a pure kernel.

@@ -1,0 +1,110 @@
+"""Radix / k-bucket partition kernels.
+
+The reference's routing table is a list of k-buckets that only ever
+splits around the node's own id (src/routing_table.cpp:176-262).  At
+steady state that is exactly a partition of peers by their
+common-prefix length with the own id: bucket b holds peers whose ids
+share the first b bits with self and differ at bit b.  This module
+vectorizes that partition and the maintenance sweeps built on it:
+
+- ``bucket_of``       peer → bucket index (= clipped commonBits with self)
+- ``bucket_counts``   per-bucket occupancy via one segment-sum
+- ``bucket_last_seen``per-bucket max last-reply time (device-side variant
+  of the staleness sweep; NodeTable.stale_buckets uses a host-side numpy
+  reduction with never-replied semantics,
+                      ↔ bucketMaintenance's 10-min rule, src/dht.cpp:1780-1838)
+- ``random_id_in_bucket`` uniform id inside a bucket's range
+                      (↔ RoutingTable::randomId, src/routing_table.cpp:67-85)
+- ``estimate_network_size`` 8·2^depth (↔ callbacks.h:54)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ids import N_LIMBS, ID_BITS, common_bits, set_bit
+
+_U32 = jnp.uint32
+
+MAX_BUCKET = ID_BITS - 1  # deepest distinct bucket (bit 159)
+
+
+def bucket_of(self_id, ids):
+    """Bucket index of each id relative to `self_id`: min(commonBits, 159).
+
+    self_id: uint32 [5]; ids: uint32 [..., 5] → int32 [...].
+    The own id (cb=160) lands in bucket 159 with its closest peers.
+    """
+    cb = common_bits(jnp.broadcast_to(self_id, ids.shape), ids)
+    return jnp.minimum(cb, MAX_BUCKET)
+
+
+@jax.jit
+def bucket_counts(self_id, ids, valid):
+    """Occupancy of each of the 160 buckets.  int32 [160]."""
+    b = bucket_of(self_id, ids)
+    return jax.ops.segment_sum(
+        valid.astype(jnp.int32), b, num_segments=ID_BITS, indices_are_sorted=False
+    )
+
+
+@jax.jit
+def bucket_last_seen(self_id, ids, valid, last_seen):
+    """Per-bucket max of `last_seen` (float32/float64 [N]) over valid rows.
+    Buckets with no valid node get -inf.  [160]."""
+    b = bucket_of(self_id, ids)
+    vals = jnp.where(valid, last_seen, -jnp.inf)
+    return jax.ops.segment_max(vals, b, num_segments=ID_BITS)
+
+
+# host-precomputed prefix masks: row b = mask of the first b bits
+_PREFIX_MASKS = np.zeros((ID_BITS + 1, N_LIMBS), dtype=np.uint32)
+for _b in range(ID_BITS + 1):
+    full, rem = divmod(_b, 32)
+    _PREFIX_MASKS[_b, :full] = 0xFFFFFFFF
+    if rem and full < N_LIMBS:
+        _PREFIX_MASKS[_b, full] = (0xFFFFFFFF << (32 - rem)) & 0xFFFFFFFF
+del _b
+
+
+def random_id_in_bucket(self_id, bucket, key):
+    """Uniform random id inside bucket `bucket`'s range: shares the first
+    `bucket` bits with self, differs at bit `bucket`, random after
+    (↔ RoutingTable::randomId, src/routing_table.cpp:67-85).
+
+    bucket: int32 [...]; returns uint32 [..., 5].
+    """
+    bucket = jnp.asarray(bucket, jnp.int32)
+    shape = bucket.shape + (N_LIMBS,)
+    rand = jax.random.bits(key, shape, dtype=jnp.uint32)
+    masks = jnp.take(jnp.asarray(_PREFIX_MASKS), jnp.clip(bucket, 0, ID_BITS), axis=0)
+    out = (jnp.broadcast_to(self_id, shape) & masks) | (rand & ~masks)
+    # force the differing bit: flip self's bit at `bucket`
+    self_bit = jnp.broadcast_to(
+        _bit_at(jnp.broadcast_to(self_id, shape), bucket), bucket.shape
+    )
+    return set_bit(out, bucket, ~self_bit)
+
+
+def _bit_at(ids, nbit):
+    from .ids import get_bit
+
+    return get_bit(ids, nbit)
+
+
+def estimate_network_size(self_id, ids, valid, k: int = 8):
+    """Network size estimate k·2^depth (↔ NodeStats, callbacks.h:47-67).
+
+    In the reference, table depth is the own-bucket prefix length, which
+    grows only while the own bucket keeps k nodes and splits.  Flat-radix
+    equivalent: depth = deepest d such that ≥ k valid nodes share a
+    ≥ d-bit prefix with self.
+    """
+    counts = bucket_counts(self_id, ids, valid)
+    # nodes with cb >= d, for each d: reverse cumulative sum
+    ge = jnp.cumsum(counts[::-1])[::-1]
+    depths = jnp.nonzero(ge >= k, size=ID_BITS, fill_value=-1)[0]
+    depth = jnp.max(depths)
+    return jnp.where(depth < 0, jnp.sum(counts), k * (2 ** jnp.clip(depth, 0, 30)))

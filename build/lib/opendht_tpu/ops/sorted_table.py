@@ -468,46 +468,6 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     return top_dist, top_idx, certified
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select", "cap"))
-def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
-                 k: int = 8, select: str = "fast2", cap: int = 128):
-    """Two-stage certified lookup in ONE device call — the headline
-    kernel (bench.py).
-
-    Stage 1: :func:`expanded_topk` over the narrow fast expansion
-    (stride 42 → 126-row windows that sort in exactly 128 padded lanes)
-    with LUT-only positioning.  ~99.997% of uniform queries certify.
-    Stage 2: up to ``cap`` uncertified rows are selected ON DEVICE
-    (``jnp.nonzero(size=cap)`` — static shape, no host sync, no cond)
-    and re-looked-up against the wide stride-64 expansion, whose
-    64-row margins certify everything stage 1 missed on non-adversarial
-    tables.  Rows neither stage certifies (> cap failures, or
-    adversarial clustering) come back with ``certified=False`` and the
-    caller falls back exactly (lookup_topk's host path).
-
-    This replaces a full-scan fallback that cost 520 ms per batch at
-    Q=128×N=1M (the tiled scan serializes ~245 tiny sort steps) with a
-    ~0.5 ms always-on second pass.  Returns (dist|None, idx, certified)
-    with the :func:`expanded_topk` contract.
-    """
-    d, idx, cert = expanded_topk(sorted_ids, exp_fast, n_valid, queries,
-                                 k=k, select=select, lut=lut, lut_steps=0)
-    bad = jnp.nonzero(~cert, size=cap, fill_value=0)[0]
-    qb = jnp.take(queries, bad, axis=0)
-    # full-depth positioning for the rescue rows: 128 rows, cost-free
-    d2, i2, c2 = expanded_topk(sorted_ids, exp_wide, n_valid, qb,
-                               k=k, select=select, lut=None)
-    was_bad = jnp.take(~cert, bad)
-    take = was_bad & c2
-    old_idx = jnp.take(idx, bad, axis=0)
-    idx = idx.at[bad].set(jnp.where(take[:, None], i2, old_idx))
-    if d is not None and d2 is not None:
-        old_d = jnp.take(d, bad, axis=0)
-        d = d.at[bad].set(jnp.where(take[:, None, None], d2, old_d))
-    cert = cert.at[bad].set(jnp.take(cert, bad) | c2)
-    return d, idx, cert
-
-
 @functools.partial(jax.jit, static_argnames=("k", "window", "select",
                                              "lut_steps", "tile"))
 def _lookup_topk_device(sorted_ids, expanded, n_valid, queries, lut, *,

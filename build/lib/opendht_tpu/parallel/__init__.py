@@ -1,0 +1,13 @@
+"""Multi-chip scale-out: sharded node tables + collective top-k merge."""
+
+from .sharded import (  # noqa: F401
+    make_mesh,
+    pad_to_multiple,
+    sharded_xor_topk,
+    sharded_sort_table,
+    sharded_expand_table,
+    sharded_window_lookup,
+    sharded_lookup,
+    dp_simulate_lookups,
+    tp_simulate_lookups,
+)

@@ -1,0 +1,16 @@
+"""L4 — the node runtime: the full DHT node core (``Dht``), its live
+search machinery, and the async runner façade.
+
+The architectural split (SURVEY.md §7): per-packet protocol state — the
+msgpack RPC engine, request retries, per-search token/listen/announce
+bookkeeping — stays host-side where latency-bound scalar work belongs;
+*all* closest-node math goes through the TPU-backed
+:class:`~opendht_tpu.core.table.NodeTable` device snapshots, so a node
+serving thousands of concurrent lookups resolves them in a handful of
+batched XOR top-k device calls instead of per-search scalar scans
+(reference: ``RoutingTable::findClosestNodes``
+src/routing_table.cpp:109-150, ``NodeCache::getCachedNodes``
+src/node_cache.cpp:41-74)."""
+
+from .config import Config, NodeStatus, NodeStats, DEFAULT_STORAGE_LIMIT  # noqa: F401
+from .dht import Dht  # noqa: F401

@@ -1,0 +1,66 @@
+"""Node status, stats and configuration (reference
+include/opendht/callbacks.h:41-117)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..infohash import InfoHash
+
+#: total value-store budget per node (callbacks.h:117)
+DEFAULT_STORAGE_LIMIT = 64 * 1024 * 1024
+
+
+class NodeStatus(enum.Enum):
+    """(callbacks.h:41-45)"""
+    DISCONNECTED = 0     # 0 nodes
+    CONNECTING = 1       # 1+ nodes known, no confirmed peer yet
+    CONNECTED = 2        # 1+ good nodes
+
+
+@dataclass
+class NodeStats:
+    """Routing-table health counters (callbacks.h:47-67)."""
+    good_nodes: int = 0
+    dubious_nodes: int = 0
+    cached_nodes: int = 0
+    incoming_nodes: int = 0
+    table_depth: int = 0
+    searches: int = 0
+    node_cache_size: int = 0
+
+    def get_known_nodes(self) -> int:
+        return self.good_nodes + self.dubious_nodes
+
+    def get_network_size_estimation(self) -> int:
+        """8 · 2^depth (callbacks.h:54)."""
+        return 8 * (2 ** self.table_depth)
+
+    def to_dict(self) -> dict:
+        return {
+            "good": self.good_nodes, "dubious": self.dubious_nodes,
+            "cached": self.cached_nodes, "incoming": self.incoming_nodes,
+            "searches": self.searches, "node_cache": self.node_cache_size,
+            "table_depth": self.table_depth,
+            "network_size_estimation": self.get_network_size_estimation(),
+        }
+
+
+@dataclass
+class Config:
+    """DHT node configuration (callbacks.h:90-106)."""
+    node_id: Optional[InfoHash] = None
+    network: int = 0                 # netid partitioning the DHT
+    is_bootstrap: bool = False       # client mode: don't join tables
+    maintain_storage: bool = False   # republish values toward closer nodes
+    storage_limit: int = DEFAULT_STORAGE_LIMIT
+    max_req_per_sec: int = 1600      # ingress budget; per-IP = this // 8
+
+
+@dataclass
+class SecureDhtConfig:
+    """(callbacks.h:111-115); identity = (PrivateKey, Certificate)."""
+    node_config: Config = field(default_factory=Config)
+    identity: Optional[tuple] = None

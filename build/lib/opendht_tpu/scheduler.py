@@ -1,0 +1,124 @@
+"""Time-ordered job scheduler — the single-threaded runtime driver.
+
+Counterpart of the reference ``Scheduler`` (include/opendht/scheduler.h:37-122):
+every periodic behavior in the network engine and DHT core is a job keyed
+by a time point; ``run()`` executes everything due and reports the next
+wakeup so the owning loop can sleep exactly that long.
+
+Python-idiomatic design: a heapq of (time, seq, Job) entries with lazy
+deletion — ``cancel``/``edit`` just drop the callable, and stale heap
+entries are skipped when popped (the reference reschedules by re-emplacing
+into a multimap, same effect).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time as _time
+from typing import Callable, Optional
+
+from .utils import TIME_MAX
+
+
+class Job:
+    """A scheduled callable. ``cancel()`` clears it (scheduler.h:41-44).
+    ``time`` tracks the pending fire time (None once popped/parked) so
+    callers can compare against an intended reschedule."""
+
+    __slots__ = ("func", "time")
+
+    def __init__(self, func: Optional[Callable[[], None]]):
+        self.func = func
+        self.time: Optional[float] = None
+
+    def cancel(self) -> None:
+        self.func = None
+
+    @property
+    def cancelled(self) -> bool:
+        return self.func is None
+
+
+class Scheduler:
+    def __init__(self, clock: Callable[[], float] = _time.monotonic):
+        self._clock = clock
+        self._now = clock()
+        self._heap: list[tuple[float, int, Job]] = []
+        self._seq = itertools.count()
+
+    # -- queue ops ---------------------------------------------------------
+    def add(self, t: float, func: Callable[[], None]) -> Job:
+        """Schedule ``func`` at time ``t``; returns the Job handle
+        (scheduler.h:53-58). t == TIME_MAX means 'parked': the job exists
+        but is not queued."""
+        job = Job(func)
+        if t != TIME_MAX:
+            job.time = t
+            heapq.heappush(self._heap, (t, next(self._seq), job))
+        return job
+
+    def queue(self, job: Job, t: float) -> None:
+        """Re-enqueue an existing job at ``t`` (scheduler.h:60-63)."""
+        if t != TIME_MAX:
+            job.time = t
+            heapq.heappush(self._heap, (t, next(self._seq), job))
+
+    def edit(self, job: Optional[Job], t: float) -> Optional[Job]:
+        """Reschedule: cancel the old entry, return a fresh Job at ``t``
+        (scheduler.h:70-80 — the reference also invalidates the old
+        shared_ptr's callable and re-adds)."""
+        if job is None:
+            return None
+        func = job.func
+        job.func = None
+        job.time = None
+        return self.add(t, func) if func is not None else None
+
+    # -- execution ---------------------------------------------------------
+    def run(self) -> float:
+        """Run all jobs due as of now; return next wakeup time
+        (scheduler.h:87-106).  Jobs scheduled for a time strictly after the
+        synced 'now' are left for the next run, so a job that reschedules
+        itself for 'now + d' cannot starve the loop."""
+        self.sync_time()
+        heap = self._heap
+        # Snapshot the due entries first: a job that re-adds itself for
+        # "now" during this sweep waits for the next run() instead of
+        # spinning the loop (the reference relies on real time advancing
+        # for the same guarantee, scheduler.h:90-95).
+        due = []
+        while heap and heap[0][0] <= self._now:
+            t, _, job = heapq.heappop(heap)
+            job.time = None
+            due.append((t, job))
+        try:
+            while due:
+                _, job = due.pop(0)
+                func = job.func
+                if func is not None:
+                    func()
+        finally:
+            # If a job raised, the not-yet-run due jobs go back on the
+            # heap instead of being silently lost with the local list.
+            for t, job in due:
+                heapq.heappush(heap, (t, next(self._seq), job))
+        return self.next_job_time()
+
+    def next_job_time(self) -> float:
+        heap = self._heap
+        while heap and heap[0][2].cancelled:
+            heapq.heappop(heap)
+        return heap[0][0] if heap else TIME_MAX
+
+    # -- time reference ----------------------------------------------------
+    def time(self) -> float:
+        """The common synchronized time reference (scheduler.h:116)."""
+        return self._now
+
+    def sync_time(self) -> float:
+        self._now = self._clock()
+        return self._now
+
+    def __len__(self) -> int:
+        return sum(1 for *_, j in self._heap if not j.cancelled)

@@ -1,0 +1,22 @@
+"""Multi-node test/bench harness (↔ reference python/tools/dht/*).
+
+Two backends:
+
+- :class:`VirtualNet` — deterministic in-process virtual UDP network
+  over ``Dht`` cores with a virtual clock (replaces the reference's
+  netns + netem tier, virtual_network_builder.py).
+- :class:`DhtNetwork` — N real ``DhtRunner`` nodes on localhost UDP
+  (the reference's in-namespace node cluster, dht/network.py:283-436).
+
+Scenario suites (↔ dht/tests.py): :class:`PerformanceTest` (gets latency
+histograms, node-kill delete test), :class:`PersistenceTest` (value
+survival under churn).  CLI driver: ``python -m
+opendht_tpu.testing.benchmark`` (↔ benchmark.py).
+"""
+
+from .virtual_net import VirtualNet
+from .network import DhtNetwork
+from .scenarios import PerformanceTest, PersistenceTest, LatencyStats
+
+__all__ = ["VirtualNet", "DhtNetwork", "PerformanceTest",
+           "PersistenceTest", "LatencyStats"]

@@ -1,0 +1,95 @@
+"""DhtNetwork: a cluster of real DhtRunner nodes on localhost UDP
+(↔ reference python/tools/dht/network.py:283-436 — the in-namespace
+node cluster; the netns/veth/netem tier is replaced by
+:class:`~opendht_tpu.testing.virtual_net.VirtualNet`'s simulated
+delay/loss)."""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import List, Optional
+
+from ..core.value import Value
+from ..infohash import InfoHash
+from ..runtime.config import Config, NodeStatus
+from ..runtime.runner import DhtRunner, RunnerConfig
+
+
+class DhtNetwork:
+    """Manage N in-process runners bound to localhost
+    (↔ DhtNetwork, network.py:283-340)."""
+
+    def __init__(self, num_nodes: int = 8, *,
+                 config: Optional[Config] = None, seed: int = 0):
+        self.config = config or Config()
+        self.rng = random.Random(seed)
+        self.nodes: List[DhtRunner] = []
+        self.bootstrap_addr = None
+        for _ in range(num_nodes):
+            self.launch_node()
+
+    # ------------------------------------------------------------- topology
+    def launch_node(self) -> DhtRunner:
+        """(↔ DhtNetwork.launch_node, network.py:341-360)"""
+        r = DhtRunner()
+        r.run(0, RunnerConfig(dht_config=self.config))
+        if self.bootstrap_addr is None:
+            self.bootstrap_addr = ("127.0.0.1", r.get_bound_port())
+        else:
+            r.bootstrap(*self.bootstrap_addr)
+        self.nodes.append(r)
+        return r
+
+    def shutdown_node(self, node: Optional[DhtRunner] = None) -> None:
+        """Stop one node (random non-seed by default)
+        (↔ DhtNetworkSubProcess shutdown requests, network.py:377-436)."""
+        if node is None:
+            if len(self.nodes) <= 1:
+                return
+            node = self.rng.choice(self.nodes[1:])
+        self.nodes.remove(node)
+        node.join()
+
+    def replace_cluster(self, count: int) -> List[DhtRunner]:
+        """Kill ``count`` random non-seed nodes, launch replacements
+        (↔ cluster replacement during test rounds, dht/tests.py:905-910)."""
+        victims = self.rng.sample(self.nodes[1:],
+                                  min(count, len(self.nodes) - 1))
+        for v in victims:
+            self.shutdown_node(v)
+        return [self.launch_node() for _ in victims]
+
+    def shutdown(self) -> None:
+        for r in self.nodes:
+            r.join()
+        self.nodes.clear()
+
+    # ------------------------------------------------------------- plumbing
+    def wait_connected(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.get_status() is NodeStatus.CONNECTED
+                   for r in self.nodes):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def random_node(self) -> DhtRunner:
+        return self.rng.choice(self.nodes)
+
+    def get(self, key: InfoHash, timeout: float = 30.0) -> List[Value]:
+        return self.random_node().get_sync(key, timeout=timeout)
+
+    def put(self, key: InfoHash, value: Value, timeout: float = 30.0) -> bool:
+        """(↔ the cluster put request, network.py:252-266)"""
+        return self.random_node().put_sync(key, value, timeout=timeout)
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def __enter__(self) -> "DhtNetwork":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

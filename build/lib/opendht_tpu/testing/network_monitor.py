@@ -1,0 +1,155 @@
+"""network_monitor: continuous end-to-end put→listen health probe.
+
+Analog of the reference monitor (reference python/tools/
+network_monitor.py:26-83): two local nodes bootstrap to the monitored
+network; node1 listens on N keys, node2 puts a fresh random value on
+every key each period, and the monitor reports how long the full
+put→propagate→listen round trip takes.  A timeout exits non-zero so the
+tool can drive alerting.
+
+Differences from the reference: ``--rounds`` bounds the loop (0 = run
+forever like the reference) and ``--local`` spins up a private two-node
+network instead of joining a public bootstrap, so the tool is runnable
+in sealed environments and tests.
+
+Usage::
+
+    python -m opendht_tpu.testing.network_monitor --local -n 4 --rounds 3
+    python -m opendht_tpu.testing.network_monitor -b host:port -p 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from datetime import datetime
+
+from ..infohash import InfoHash
+from ..core.value import Value
+from ..runtime.config import NodeStatus
+from ..runtime.runner import DhtRunner
+
+
+class Monitor:
+    def __init__(self, bootstrap: "tuple[str, int] | None", num_ops: int,
+                 timeout: float):
+        self.timeout = timeout
+        self.node1 = DhtRunner()
+        self.node2 = DhtRunner()
+        self.node1.run(0)
+        self.node2.run(0)
+        self._local = None
+        if bootstrap is None:
+            # private network: node1 doubles as the bootstrap
+            self.node2.bootstrap("127.0.0.1", self.node1.get_bound_port())
+        else:
+            host, port = bootstrap
+            self.node1.bootstrap(host, port)
+            self.node2.bootstrap(host, port)
+        self.keys = [InfoHash.get_random() for _ in range(num_ops)]
+        self.pending: dict = {}          # key-hex -> expected Value
+        self._cv = threading.Condition()
+        for key in self.keys:
+            self.node1.listen(key, self._make_cb(key))
+
+    def _make_cb(self, key: InfoHash):
+        kstr = key.hex()
+
+        def cb(values, expired):
+            if expired:
+                return True
+            with self._cv:
+                exp = self.pending.get(kstr)
+                if exp is not None and any(v.id == exp.id for v in values):
+                    self.pending.pop(kstr, None)
+                    self._cv.notify_all()
+            return True
+        return cb
+
+    def wait_connected(self, timeout: float = 30.0) -> bool:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if (self.node1.get_status() is NodeStatus.CONNECTED
+                    and self.node2.get_status() is NodeStatus.CONNECTED):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def run_test(self) -> float:
+        """One round: put a fresh value on every key, wait until every
+        listener heard its value.  Returns elapsed seconds; raises
+        TimeoutError on expiry (reference monitor exits 1)."""
+        start = time.monotonic()
+        with self._cv:
+            for i, key in enumerate(self.keys):
+                val = Value(InfoHash.get_random().hex().encode(),
+                            value_id=int(start * 1000) * 1000 + i + 1)
+                self.pending[key.hex()] = val
+                self.node2.put(key, val, lambda ok, nodes: None)
+            while self.pending:
+                remaining = self.timeout - (time.monotonic() - start)
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                    missing = list(self.pending)
+                    self.pending.clear()
+                    raise TimeoutError("no listen callback for %d keys: %s"
+                                       % (len(missing), missing[:4]))
+        return time.monotonic() - start
+
+    def close(self) -> None:
+        self.node1.join()
+        self.node2.join()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="monitor a DHT network with periodic put->listen probes")
+    p.add_argument("-b", "--bootstrap",
+                   help="bootstrap address host:port (default: private net)")
+    p.add_argument("-n", "--num-ops", type=int, default=8,
+                   help="concurrent keys probed per round")
+    p.add_argument("-p", "--period", type=float, default=60.0,
+                   help="seconds between rounds")
+    p.add_argument("-t", "--timeout", type=float, default=15.0,
+                   help="per-round timeout")
+    p.add_argument("--rounds", type=int, default=0,
+                   help="stop after N rounds (0 = forever)")
+    p.add_argument("--local", action="store_true",
+                   help="run against a private 2-node network")
+    args = p.parse_args(argv)
+
+    bootstrap = None
+    if args.bootstrap and not args.local:
+        host, _, port = args.bootstrap.partition(":")
+        bootstrap = (host, int(port or 4222))
+
+    mon = Monitor(bootstrap, args.num_ops, args.timeout)
+    try:
+        if not mon.wait_connected():
+            print("monitor: nodes failed to connect", file=sys.stderr)
+            return 1
+        next_test = time.monotonic()
+        done_rounds = 0
+        while args.rounds == 0 or done_rounds < args.rounds:
+            try:
+                dt = mon.run_test()
+            except TimeoutError as e:
+                print("Test timeout !", e, file=sys.stderr)
+                return 1
+            print(datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+                  "Test completed successfully in", round(dt, 3))
+            done_rounds += 1
+            if args.rounds and done_rounds >= args.rounds:
+                break
+            next_test += args.period
+            now = time.monotonic()
+            if next_test > now:
+                time.sleep(next_test - now)
+    finally:
+        mon.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

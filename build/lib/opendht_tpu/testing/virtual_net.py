@@ -37,12 +37,6 @@ class VirtualNet:
         self._seq = itertools.count()
         self._next_port = 20000
         self.dropped = 0
-        # lazy min-heap over per-node next-job times: O(log N) per event
-        # instead of scanning every scheduler per event (the O(N·events)
-        # scan capped clusters at a few hundred nodes; hop-parity needs
-        # 2K-8K).  Entries (t, key) are stale unless _ntimes[key] == t.
-        self._ntimes: Dict[tuple, float] = {}
-        self._sheap: list = []
 
     # ------------------------------------------------------------- topology
     def add_node(self, config: Optional[Config] = None,
@@ -81,10 +75,6 @@ class VirtualNet:
         python/tools/dht/network.py:377-436)."""
         key = (dht.bound_addr.host, dht.bound_addr.port)
         self.nodes.pop(key, None)
-        # drop the cached wakeup too: a later add_node on the same
-        # (host, port) with an equal next_job_time would otherwise be
-        # skipped by _refresh's equality check and never run
-        self._ntimes.pop(key, None)
 
     def replace_cluster(self, count: int, seed_node: Dht,
                         config: Optional[Config] = None) -> List[Dht]:
@@ -114,73 +104,35 @@ class VirtualNet:
                 self.bootstrap_node(dht, seed_node)
 
     # ------------------------------------------------------------ event loop
-    def _refresh(self, key) -> None:
-        """Re-cache one node's next scheduler wakeup in the lazy heap."""
-        dht = self.nodes.get(key)
-        if dht is None:
-            self._ntimes.pop(key, None)
-            return
-        t = dht.scheduler.next_job_time()
-        if self._ntimes.get(key) != t:
-            self._ntimes[key] = t
-            if t < TIME_MAX:
-                heapq.heappush(self._sheap, (t, key))
-
-    def _peek_sched(self) -> float:
-        while self._sheap:
-            t, key = self._sheap[0]
-            if key in self.nodes and self._ntimes.get(key) == t:
-                return t
-            heapq.heappop(self._sheap)          # stale
-        return TIME_MAX
-
     def _next_event_time(self) -> float:
         t = self._queue[0][0] if self._queue else TIME_MAX
-        return min(t, self._peek_sched())
+        for dht in self.nodes.values():
+            t = min(t, dht.scheduler.next_job_time())
+        return t
 
     def run(self, max_time: float = 30.0,
             until: Optional[Callable[[], bool]] = None,
-            max_events: int = 5_000_000, check_every: int = 32) -> bool:
-        """Advance virtual time; returns True as soon as `until()` holds.
-
-        ``until`` is evaluated every ``check_every`` events (it is often
-        an O(N) sweep like all_connected — per-event evaluation made big
-        clusters quadratic).  Each run() entry re-syncs every node's
-        cached wakeup once, so jobs scheduled by direct test calls
-        between runs (obs.get(...), bootstrap) are picked up.
-        """
+            max_events: int = 1_000_000) -> bool:
+        """Advance virtual time; returns True as soon as `until()` holds."""
         deadline = self.clock + max_time
-        for key in self.nodes:
-            self._refresh(key)
-        for i in range(max_events):
-            if until is not None and i % check_every == 0 and until():
+        for _ in range(max_events):
+            if until is not None and until():
                 return True
             t = self._next_event_time()
             if t > deadline:
                 self.clock = deadline
                 break
             self.clock = max(self.clock, t)
-            touched = set()
             # deliver all packets due now
             while self._queue and self._queue[0][0] <= self.clock:
                 _, _, data, src, dst_key = heapq.heappop(self._queue)
                 dst = self.nodes.get(dst_key)
                 if dst is not None:
                     dst.periodic(data, src)
-                    touched.add(dst_key)
-            # run due scheduler jobs (each due node once, via the heap)
-            while True:
-                ts = self._peek_sched()
-                if ts > self.clock:
-                    break
-                _, key = heapq.heappop(self._sheap)
-                self._ntimes.pop(key, None)
-                dht = self.nodes.get(key)
-                if dht is not None:
+            # run due scheduler jobs everywhere
+            for dht in self.nodes.values():
+                if dht.scheduler.next_job_time() <= self.clock:
                     dht.periodic(None, None)
-                    touched.add(key)
-            for key in touched:
-                self._refresh(key)
         return until() if until is not None else False
 
     def settle(self, seconds: float) -> None:

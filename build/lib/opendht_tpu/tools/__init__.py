@@ -1,0 +1,3 @@
+"""CLI tools (↔ reference tools/): dhtnode interactive node/daemon,
+dhtchat minimal IM, dhtscanner keyspace census, plus shared argv/identity
+helpers (↔ tools/tools_common.h)."""

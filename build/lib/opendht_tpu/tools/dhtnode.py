@@ -1,0 +1,318 @@
+"""dhtnode: interactive CLI node / daemon (↔ reference tools/dhtnode.cpp).
+
+REPL ops (cmd_loop, dhtnode.cpp:104-460):
+    h                      help
+    x / q / quit           exit
+    ll                     print routing tables, searches and storage logs
+    lr                     routing tables log
+    ls [hash]              searches log
+    la                     storage (announced values) log
+    b <host[:port]>        bootstrap
+    cc                     simulate connectivity change
+    g <hash>               get
+    l <hash>               listen (prints updates; 'cl <token>' to stop)
+    cl <token>             cancel listen
+    p <hash> <text>        put
+    pp <hash> <text>       permanent put
+    cpp <hash> <vid>       cancel permanent put
+    s <hash> <text>        put signed
+    e <hash> <to> <text>   put encrypted to recipient hash
+    q? <hash> <where>      query (e.g. q? <hash> id=42)
+    il <name> <key> [vid]  index: insert (key as field=value)
+    ii <name> <key>        index: lookup
+    stt <port>             start REST proxy server
+    stp                    stop REST proxy server
+    pst <host:port>        switch backend to a REST proxy (client)
+    psp                    switch back to the UDP backend
+    info                   node id, port, stats
+"""
+
+from __future__ import annotations
+
+import shlex
+import socket
+import sys
+import time
+
+from ..infohash import InfoHash
+from ..core.value import Value
+from .common import (make_arg_parser, parse_bootstrap, print_node_info,
+                     print_node_stats, setup_node)
+
+
+def to_hash(word: str) -> InfoHash:
+    """40-hex-char args are hashes; anything else is hashed as a key
+    (the reference requires strict hex — dhtnode.cpp:131-138 — this is a
+    usability extension)."""
+    if len(word) == 2 * InfoHash.HASH_LEN:
+        try:
+            return InfoHash(word)
+        except Exception:
+            pass
+    return InfoHash.get(word)
+
+HELP = __doc__
+
+
+def _value_str(v: Value) -> str:
+    flags = []
+    if v.is_signed():
+        flags.append("signed")
+    if v.is_encrypted():
+        flags.append("encrypted")
+    body = v.data.decode("utf-8", "replace") if not v.is_encrypted() else "<cypher>"
+    return "Value[id:%x%s%s] %r" % (
+        v.id, " " if flags else "", ",".join(flags), body)
+
+
+def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
+    """(↔ cmd_loop, dhtnode.cpp:104-460)"""
+    from ..indexation.pht import Pht
+
+    proxy_server = None
+    indexes = {}
+    listen_tokens = {}
+
+    print("(type 'h' for help)")
+    while True:
+        try:
+            line = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            break
+        try:
+            words = shlex.split(line)
+        except ValueError as e:
+            print("parse error: %s" % e)
+            continue
+        if not words:
+            continue
+        op, rest = words[0], words[1:]
+        try:
+            if op in ("x", "q", "exit", "quit"):
+                break
+            elif op in ("h", "help"):
+                print(HELP)
+            elif op == "info":
+                print_node_info(node)
+                print_node_stats(node)
+            elif op == "ll":
+                d = node._dht
+                for af in (socket.AF_INET,):
+                    print(d.get_routing_tables_log(af))
+                print(d.get_searches_log())
+                print(d.get_storage_log())
+            elif op == "lr":
+                print(node._dht.get_routing_tables_log(socket.AF_INET))
+            elif op == "ls":
+                print(node._dht.get_searches_log())
+            elif op == "la":
+                print(node._dht.get_storage_log())
+            elif op == "b":
+                bs = parse_bootstrap(rest[0])
+                node.bootstrap(*bs)
+                print("bootstrapping %s:%d" % bs)
+            elif op == "cc":
+                node._post(lambda dht: dht.connectivity_changed(),
+                           prio=True)
+                print("connectivity change signalled")
+            elif op == "g":
+                key = to_hash(rest[0])
+                t0 = time.monotonic()
+                vals = node.get_sync(key, timeout=30.0)
+                dt = time.monotonic() - t0
+                for v in vals:
+                    print("  %s" % _value_str(v))
+                print("Get: %d value(s) in %.3fs" % (len(vals), dt))
+            elif op == "q?":
+                from ..core.value import Query
+                key = to_hash(rest[0])
+                q_str = " ".join(rest[1:])
+                if ("where" not in q_str.lower()
+                        and "select" not in q_str.lower()):
+                    q_str = "where " + q_str    # 'q? <hash> id=42' shorthand
+                q = Query(q_str)
+                node.query(key, lambda fields: print("  fields: %s" % fields)
+                           or True, lambda ok, ns: print("Query done: %s" % ok),
+                           q)
+            elif op == "l":
+                key = to_hash(rest[0])
+                tok = node.listen(key, lambda vals, expired: [
+                    print("  %s %s" % ("EXPIRED" if expired else "LISTEN",
+                                       _value_str(v))) for v in vals
+                ] or True)
+                t = tok.result(10.0)
+                listen_tokens[t] = key
+                print("listening, token %d" % t)
+            elif op == "cl":
+                t = int(rest[0])
+                node.cancel_listen(listen_tokens.pop(t), t)
+                print("cancelled %d" % t)
+            elif op in ("p", "pp"):
+                key = to_hash(rest[0])
+                v = Value(" ".join(rest[1:]).encode())
+                ok = node.put_sync(key, v, timeout=30.0,
+                                   permanent=(op == "pp"))
+                # the node assigns the random value id; 'cpp' needs it
+                print("Put: %s (id %x)" % (ok, v.id))
+            elif op == "cpp":
+                node.cancel_put(to_hash(rest[0]),
+                                int(rest[1], 16))
+                print("cancelled")
+            elif op == "s":
+                key = to_hash(rest[0])
+                done = []
+                node.put_signed(key, Value(" ".join(rest[1:]).encode()),
+                                lambda ok, ns: done.append(ok))
+                _wait(done)
+                print("PutSigned: %s" % (done and done[0]))
+            elif op == "e":
+                key = to_hash(rest[0])
+                to = to_hash(rest[1])
+                done = []
+                node.put_encrypted(key, to,
+                                   Value(" ".join(rest[2:]).encode()),
+                                   lambda ok, ns: done.append(ok))
+                _wait(done)
+                print("PutEncrypted: %s" % (done and done[0]))
+            elif op in ("il", "ii"):
+                name = rest[0]
+                if name not in indexes:
+                    indexes[name] = Pht(name, {"k": 20}, node)
+                pht = indexes[name]
+                field = rest[1].encode()
+                done = []
+                if op == "il":
+                    vid = int(rest[2]) if len(rest) > 2 else 1
+                    pht.insert({"k": bytes(InfoHash.get(field))},
+                               (node.get_node_id(), vid),
+                               lambda ok: done.append(ok))
+                    _wait(done)
+                    print("Index insert: %s" % (done and done[0]))
+                else:
+                    pht.lookup({"k": bytes(InfoHash.get(field))},
+                               cb=lambda vals, prefix: print(
+                                   "  index values: %s" % (vals,)),
+                               done_cb=lambda ok: done.append(ok))
+                    _wait(done)
+                    print("Lookup: %s" % (done and done[0]))
+            elif op == "log":
+                # toggle / route logging (↔ dhtnode.cpp:87-96)
+                from ..log import DhtLogger
+                if not hasattr(node, "_cli_logger"):
+                    node._cli_logger = DhtLogger()
+                lg = node._cli_logger
+                arg = rest[0] if rest else "on"
+                if arg == "off":
+                    lg.disable()
+                    print("logging off")
+                elif arg == "file":
+                    lg.set_sink_file(rest[1])
+                    print("logging to %s" % rest[1])
+                elif arg == "syslog":
+                    lg.set_sink_syslog()
+                    print("logging to syslog")
+                elif len(arg) == 2 * InfoHash.HASH_LEN:
+                    lg.set_filter(InfoHash(arg))
+                    lg.set_sink_console()
+                    print("logging filtered to %s" % arg)
+                else:
+                    lg.set_filter(None)
+                    lg.set_sink_console()
+                    print("logging on")
+            elif op == "stt":
+                from ..proxy import DhtProxyServer
+                if proxy_server is not None:
+                    proxy_server.stop()
+                proxy_server = DhtProxyServer(node, int(rest[0]))
+                print("proxy server on port %d" % proxy_server.port)
+            elif op == "stp":
+                if proxy_server:
+                    proxy_server.stop()
+                    proxy_server = None
+                    print("proxy server stopped")
+            elif op == "pst":
+                node.enable_proxy(rest[0])
+                print("backend switched to proxy %s" % rest[0])
+            elif op == "psp":
+                node.enable_proxy(None)
+                print("backend switched to UDP")
+            else:
+                print("unknown op %r (h for help)" % op)
+        except IndexError:
+            print("missing argument (h for help)")
+        except Exception as e:
+            print("error: %s" % e)
+    if proxy_server:
+        proxy_server.stop()
+
+
+def _wait(done, timeout=30.0):
+    t0 = time.monotonic()
+    while not done and time.monotonic() - t0 < timeout:
+        time.sleep(0.02)
+
+
+def main(argv=None) -> int:
+    """(↔ main, dhtnode.cpp:480-545)"""
+    p = make_arg_parser("OpenDHT-TPU node CLI")
+    p.add_argument("--daemon", action="store_true",
+                   help="run non-interactively (Ctrl-C to stop)")
+    p.add_argument("--save-state", default="",
+                   help="persist nodes+values to this file on exit and "
+                        "restore them on start (checkpoint/resume)")
+    args = p.parse_args(argv)
+    node = setup_node(args)
+    print_node_info(node)
+    # SIGTERM (systemd/docker stop) must run the finally block so
+    # --save-state persists for daemon deployments
+    import signal as _signal
+
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass     # not the main thread / unsupported platform
+    if args.save_state:
+        import os as _os
+        if _os.path.exists(args.save_state):
+            from .common import load_state
+            try:
+                n_nodes, n_keys = load_state(node, args.save_state)
+                print("restored %d nodes, %d keys from %s"
+                      % (n_nodes, n_keys, args.save_state))
+            except Exception as e:
+                # a corrupt state file must not keep the node from
+                # starting (the save path warns symmetrically)
+                print("state restore failed: %s" % e)
+    proxy_server = None
+    if args.proxyserver:
+        from ..proxy import DhtProxyServer
+        proxy_server = DhtProxyServer(node, args.proxyserver)
+        print("proxy server on port %d" % proxy_server.port)
+    try:
+        if args.daemon:
+            while True:
+                time.sleep(3600)
+        else:
+            cmd_loop(node, args)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if args.save_state:
+            try:
+                from .common import save_state
+                save_state(node, args.save_state)
+                print("state saved to %s" % args.save_state)
+            except Exception as e:
+                print("state save failed: %s" % e)
+        if proxy_server:
+            proxy_server.stop()
+        node.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

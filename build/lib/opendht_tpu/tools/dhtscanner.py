@@ -1,0 +1,63 @@
+"""dhtscanner: census the network by walking the keyspace
+(↔ reference tools/dhtscanner.cpp:40-135: search successive ids spread
+over the ring, collecting every node seen in replies)."""
+
+from __future__ import annotations
+
+import socket
+import sys
+import time
+
+from ..infohash import InfoHash
+from .common import make_arg_parser, print_node_info, setup_node
+
+
+def scan(node, rounds: int = 32, timeout: float = 15.0) -> dict:
+    """Issue `rounds` gets at ids evenly spaced over the 160-bit ring;
+    harvest the union of nodes from the routing table after each
+    (dhtscanner.cpp:52-99 steps a prefix counter the same way)."""
+    seen = {}
+    for i in range(rounds):
+        target = InfoHash.from_int((i << 152) | (1 << 151))
+        done = []
+        node.get(target, lambda vals: True,
+                 lambda ok, nodes: done.append([
+                     (n.id, n.addr) for n in nodes or []]))
+        t0 = time.monotonic()
+        while not done and time.monotonic() - t0 < timeout:
+            time.sleep(0.02)
+        for nid, addr in (done[0] if done else []):
+            seen[nid] = addr
+        print("scan %2d/%d: target %s…, %d nodes known"
+              % (i + 1, rounds, str(target)[:8], len(seen)))
+    return seen
+
+
+def main(argv=None) -> int:
+    p = make_arg_parser("OpenDHT-TPU network scanner")
+    p.add_argument("--rounds", type=int, default=32,
+                   help="number of keyspace probes")
+    args = p.parse_args(argv)
+    node = setup_node(args)
+    print_node_info(node)
+    try:
+        # wait for connectivity before scanning (dhtscanner.cpp:109-117)
+        from ..runtime.config import NodeStatus
+        t0 = time.monotonic()
+        while (node.get_status() is not NodeStatus.CONNECTED
+               and time.monotonic() - t0 < 30.0):
+            time.sleep(0.1)
+        seen = scan(node, args.rounds)
+        print("\n%d nodes discovered:" % len(seen))
+        for nid, addr in sorted(seen.items(), key=lambda kv: str(kv[0])):
+            print("  %s  %s" % (nid, addr))
+        stats = node.get_node_stats(socket.AF_INET)
+        print("network size estimation: %d"
+              % stats.get_network_size_estimation())
+    finally:
+        node.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,18 @@
 # driver entry checks and a CPU-scaled bench smoke.
 set -e
 cd "$(dirname "$0")/.."
+# packaging smoke: the wheel must build and every console entry point
+# must resolve (catches pyproject drift before the Docker tier does)
+python -m pip wheel --no-build-isolation --no-deps -q -w /tmp/odt-ci-wheel .
+python - <<'PY'
+from opendht_tpu.tools.dhtnode import main as a
+from opendht_tpu.tools.dhtchat import main as b
+from opendht_tpu.tools.dhtscanner import main as c
+print("entry points ok")
+PY
 python -m pytest tests/ -q
+# README/PARITY must quote the last accelerator bench capture verbatim
+python ci/check_docs.py
 python - <<'PY'
 import os
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"

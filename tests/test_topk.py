@@ -392,3 +392,85 @@ def test_expanded_fast2_idx_exact():
         want = _oracle_topk(q2_raw[qi], table_raw2, 8)
         got = [pp[j] for j in np.asarray(i2[qi]) if j >= 0]
         assert got == [w[1] for w in want], f"tie query {qi}"
+
+
+@pytest.mark.parametrize("stride", [32, 42, 64])
+def test_expanded_topk_parametric_stride(stride):
+    """expand_table generalizes over stride (window = 3·stride): every
+    stride must stay exact on certified rows and the certificate must
+    stay sound.  stride=42 (126-window — pads to exactly 128 sort lanes)
+    is the headline-bench geometry (bench.py HEADLINE_STRIDE)."""
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
+                                              expanded_topk)
+    from opendht_tpu.ops.xor_topk import xor_topk
+    rng = np.random.default_rng(31)
+    table_raw = rng.integers(0, 256, size=(4096, 20), dtype=np.uint8)
+    ids = jnp.asarray(K.ids_from_bytes(table_raw))
+    valid = np.ones(4096, bool)
+    valid[::7] = False
+    sorted_ids, perm, n_valid = sort_table(ids, jnp.asarray(valid))
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    exp = expand_table(sorted_ids, stride=stride)
+    q_raw = rng.integers(0, 256, size=(128, 20), dtype=np.uint8)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    d_ref, i_ref = xor_topk(q, sorted_ids, k=16,
+                            valid=jnp.arange(4096) < n_valid)
+    # both the bounded positioning search and the LUT-only (0-step) mode
+    for steps in (None, 0):
+        d, i, c = expanded_topk(sorted_ids, exp, n_valid, q, k=16,
+                                select="fast2", lut=lut, lut_steps=steps)
+        assert d is None
+        c_np = np.asarray(c)
+        assert c_np.mean() > 0.9, (stride, steps)
+        np.testing.assert_array_equal(np.asarray(i)[c_np],
+                                      np.asarray(i_ref)[c_np])
+    # and the full pipeline (device-side exact fallback) repairs the rest
+    _, i_full, c_full = lookup_topk(sorted_ids, n_valid, q, k=16, lut=lut,
+                                    expanded=exp, select="fast2")
+    assert bool(np.asarray(c_full).all())
+    np.testing.assert_array_equal(np.asarray(i_full), np.asarray(i_ref))
+
+
+def test_cascade_topk_two_stage_device_repair():
+    """cascade_topk: stage-1 (stride-42, LUT-only positioning) misses are
+    repaired on device by the wide stride-64 rescan; residual
+    uncertified rows (cap overflow / adversarial) stay flagged and the
+    host fallback path remains exact."""
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
+                                              cascade_topk)
+    from opendht_tpu.ops.xor_topk import xor_topk
+    rng = np.random.default_rng(33)
+    table_raw = rng.integers(0, 256, size=(8192, 20), dtype=np.uint8)
+    ids = jnp.asarray(K.ids_from_bytes(table_raw))
+    sorted_ids, perm, n_valid = sort_table(ids)
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    e42 = expand_table(sorted_ids, stride=42)
+    e64 = expand_table(sorted_ids, stride=64)
+    q_raw = rng.integers(0, 256, size=(512, 20), dtype=np.uint8)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    d_ref, i_ref = xor_topk(q, sorted_ids, k=16)
+
+    d, i, c = cascade_topk(sorted_ids, e42, e64, n_valid, q, lut, k=16,
+                           select="fast2")
+    assert d is None
+    c_np = np.asarray(c)
+    assert c_np.mean() > 0.99
+    np.testing.assert_array_equal(np.asarray(i)[c_np],
+                                  np.asarray(i_ref)[c_np])
+
+    # adversarial cluster: most stage-1 windows misplace AND overflow the
+    # cap — flagged rows must stay flagged, certified rows stay exact
+    t2 = rng.integers(0, 256, size=(4096, 20), dtype=np.uint8)
+    t2[:3500, :10] = 0x5A
+    ids2 = jnp.asarray(K.ids_from_bytes(t2))
+    s2, p2, nv2 = sort_table(ids2)
+    lut2 = build_prefix_lut(s2, nv2)
+    q2_raw = t2[:400].copy(); q2_raw[:, 15] ^= 0x0F
+    q2 = jnp.asarray(K.ids_from_bytes(q2_raw))
+    d_ref2, i_ref2 = xor_topk(q2, s2, k=16)
+    _, i2o, c2o = cascade_topk(s2, expand_table(s2, stride=42),
+                               expand_table(s2, stride=64), nv2, q2, lut2,
+                               k=16, select="fast2", cap=64)
+    c2_np = np.asarray(c2o)
+    np.testing.assert_array_equal(np.asarray(i2o)[c2_np],
+                                  np.asarray(i_ref2)[c2_np])
