@@ -88,7 +88,69 @@ def config1() -> dict:
             if baseline else None}
 
 
-def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
+def config3_tp(Q: int = 0, N: int = 0, limbs: int = 0) -> dict:
+    """Iterative search with the TABLE SHARDED over the mesh t axis
+    (parallel.tp_simulate_lookups) — each shard holds a contiguous range
+    of the global sorted order; positioning and row fetch are one psum
+    each.  This is the mode whose table exceeds one shard (and, on a
+    v5e pod slice, one chip's HBM).  Timed like every other device
+    number here: serialized-chain slope over the pre-placed compiled
+    callable (wall-clocking dispatches is never trusted — see module
+    docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from bench import chain_slope
+    from opendht_tpu.ops.sorted_table import default_lut_bits, sort_table
+    from opendht_tpu.core.search import ALPHA, SEARCH_NODES
+    from opendht_tpu.parallel import make_mesh, pad_to_multiple
+    from opendht_tpu.parallel.sharded import build_tp_lookup
+
+    n_dev = len(jax.devices())
+    N = N or (1_000_000 if n_dev > 1 else 262_144)
+    mesh = make_mesh(n_dev)
+    n_q = mesh.shape["q"]
+    Q = max(n_q, (Q or 4_096))
+    if Q % n_q:
+        Q += n_q - Q % n_q                 # round UP: never drop lookups
+    limbs = limbs or 2
+    k1, k2 = jax.random.split(jax.random.PRNGKey(30))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    targets = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
+    padded, _ = pad_to_multiple(np.asarray(sorted_ids), mesh.shape["t"])
+    shard_n = padded.shape[0] // mesh.shape["t"]
+
+    fn = build_tp_lookup(mesh, shard_n, Q, 8, 3, SEARCH_NODES, 48,
+                         default_lut_bits(shard_n), limbs)
+    sorted_placed = jax.device_put(jnp.asarray(padded),
+                                   NamedSharding(mesh, P("t", None)))
+    targets_placed = jax.device_put(targets, NamedSharding(mesh, P("q", None)))
+    nv = jnp.asarray(n_valid, jnp.int32)
+
+    out = jax.block_until_ready(
+        fn(sorted_placed, nv, targets_placed, jnp.int32(1)))
+    hops = np.asarray(out["hops"])
+    conv = float(np.asarray(out["converged"]).mean())
+
+    def body(t, sorted_placed, nv):
+        o = fn(sorted_placed, nv, t, jnp.int32(1))
+        return (jnp.sum(o["hops"].astype(jnp.float32))
+                + jnp.sum(o["converged"].astype(jnp.float32)))
+
+    dt = chain_slope(body, targets_placed, sorted_placed, nv, r1=1, r2=4)
+    return {"metric": "config3-tp table-sharded iterative search, mesh "
+                      "q=%d t=%d (table %d rows/shard), %d lookups x %d "
+                      "nodes, state_limbs=%d; p50 hops %d, converged %.3f "
+                      "(device-serialized chain slope)"
+                      % (mesh.shape["q"], mesh.shape["t"], shard_n, Q,
+                         N, limbs, int(np.percentile(hops, 50)), conv),
+            "value": round(Q / dt, 1), "unit": "lookups/s",
+            "vs_baseline": None}
+
+
+def config3(Q: int = 0, N: int = 0, chunk: int = 0,
+            limbs: int = 0) -> dict:
     """α-parallel iterative lookups to k=8 convergence.
 
     The north-star shape is ``-Q 1000000`` against the 10M-node table
@@ -131,8 +193,15 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0) -> dict:
         targets = jnp.concatenate([targets, targets[:pad]], axis=0)
     waves = [targets[i * chunk:(i + 1) * chunk] for i in range(n_waves)]
 
+    # state_limbs=2: merge sorts move 5 operands instead of 8 and the
+    # per-round reply gather fetches 2 planes instead of 5 — bitwise
+    # identical to the exact engine on random ids
+    # (tests/test_search.py::test_state_limbs_2_bitwise_identical)
+    limbs = limbs or 2
+
     def run_wave(t, sorted_ids=sorted_ids, n_valid=n_valid, lut=lut):
-        return simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8, lut=lut)
+        return simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8, lut=lut,
+                                state_limbs=limbs)
 
     # stats pass over the full burst (hops / convergence are exact)
     hops_all, conv_all = [], []
@@ -195,41 +264,124 @@ def config4() -> dict:
 
 
 def config5() -> dict:
-    """Sharded lookup with top-k merge over the mesh (all local
-    devices; multi-chip validated by dryrun_multichip)."""
+    """Sharded lookup with top-k merge at REAL table scale.
+
+    On the accelerator this runs N=64M ids (1.28 GB of ids; the
+    expanded window-row form is 3x that) — an actual slice of the 100M-
+    node BASELINE shape, bounded by one chip's HBM here (the v5e-8 in
+    BASELINE.json holds 8 such shards = 512M ids).  Alongside the
+    throughput measurement it characterizes the ICI merge cost as a
+    model, because this host has one real chip:
+
+      - wire volume is exact by construction: each query all_gathers
+        n_t per-shard top-k candidate sets of k rows x (20 B id + 4 B
+        index) = n_t * k * 24 B per query over the t axis;
+      - the merge RE-SORT is pure per-chip compute — measured here on
+        the real chip as select_topk over [Q, n_t*k] candidates for
+        n_t in {2,4,8} (chain slope, printed in the metric), so the
+        v5e-8 projection = per-shard lookup + measured merge(n_t=8)
+        + wire/ICI-bandwidth.
+    """
     import jax
     import jax.numpy as jnp
     from bench import chain_slope
     from opendht_tpu.ops.sorted_table import default_lut_bits
+    from opendht_tpu.ops.xor_topk import select_topk
     from opendht_tpu.parallel import (make_mesh, sharded_sort_table,
                                       sharded_expand_table,
                                       sharded_window_lookup)
 
     n_dev = len(jax.devices())
     on_accel = jax.devices()[0].platform != "cpu"
-    N = 8_000_000 if on_accel else 262_144
+    N = 64_000_000 if on_accel else 262_144
     Q = 65_536 if on_accel else 4_096
+    K = 8
     k1, k2 = jax.random.split(jax.random.PRNGKey(6))
-    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
     queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
     mesh = make_mesh(n_dev)
 
-    sorted_ids, perm, n_valid = jax.block_until_ready(
-        sharded_sort_table(mesh, table))
-    expanded, lut = jax.block_until_ready(
-        sharded_expand_table(mesh, sorted_ids, n_valid,
-                             bits=default_lut_bits(N // mesh.shape['t'])))
+    if on_accel and n_dev == 1:
+        # One real chip: run the PER-SHARD kernel at the full 64M scale
+        # (= one chip's share of a 512M-id v5e-8 table).  Memory is
+        # budgeted deliberately: the id matrix is generated INSIDE the
+        # sort program (no persistent input buffer) and the 3.9 GB
+        # window-row expansion is built via the chunked low-peak
+        # builder — the one-shot expand peaks ~2.5x output and OOMs.
+        # The all_gather merge is t=1-trivial here; its cost is the
+        # separately measured model below.
+        from opendht_tpu.ops.sorted_table import (build_prefix_lut,
+                                                  expand_table_chunked,
+                                                  expanded_topk, sort_table)
 
-    def body(q, sorted_ids, perm, n_valid, expanded, lut):
-        d, idx = sharded_window_lookup(mesh, q, sorted_ids, perm, n_valid,
-                                       k=8, expanded=expanded, lut=lut)
-        return jnp.sum((idx >= 0).astype(jnp.float32))
+        @jax.jit
+        def make_sorted(k):
+            return sort_table(jax.random.bits(k, (N, 5), dtype=jnp.uint32))
 
-    dt = chain_slope(body, queries, sorted_ids, perm, n_valid, expanded, lut,
-                     r1=1, r2=3)
-    return {"metric": "config5 sharded lookup, %d devices, "
-                      "%d queries x %d ids "
-                      "(device-serialized chain slope)" % (n_dev, Q, N),
+        sorted_ids, perm, n_valid = jax.block_until_ready(make_sorted(k1))
+        expanded = jax.block_until_ready(
+            expand_table_chunked(sorted_ids, chunks=8))
+        lut = jax.block_until_ready(
+            build_prefix_lut(sorted_ids, n_valid, bits=default_lut_bits(N)))
+
+        def body(q, sorted_ids, expanded, n_valid, lut):
+            d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q,
+                                      k=K, select="fast2", lut=lut,
+                                      lut_steps=0)
+            return (jnp.sum(c.astype(jnp.float32))
+                    + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
+
+        dt = chain_slope(body, queries, sorted_ids, expanded, n_valid, lut,
+                         r1=4, r2=32)
+        _, _, cert = jax.block_until_ready(
+            expanded_topk(sorted_ids, expanded, n_valid, queries, k=K,
+                          select="fast2", lut=lut, lut_steps=0))
+        cert_frac = float(np.asarray(cert).mean())
+    else:
+        cert_frac = None
+        table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+        sorted_ids, perm, n_valid = jax.block_until_ready(
+            sharded_sort_table(mesh, table))
+        del table
+        expanded, lut = jax.block_until_ready(
+            sharded_expand_table(mesh, sorted_ids, n_valid,
+                                 bits=default_lut_bits(N // mesh.shape['t'])))
+
+        def body(q, sorted_ids, perm, n_valid, expanded, lut):
+            d, idx = sharded_window_lookup(mesh, q, sorted_ids, perm, n_valid,
+                                           k=K, expanded=expanded, lut=lut)
+            return jnp.sum((idx >= 0).astype(jnp.float32))
+
+        dt = chain_slope(body, queries, sorted_ids, perm, n_valid, expanded,
+                         lut, r1=1, r2=3)
+
+    # merge-cost model: re-sort time vs shard count (single-chip compute)
+    merge_ms = {}
+    for n_t in (2, 4, 8):
+        kc = jax.random.split(jax.random.PRNGKey(60 + n_t))
+        cd = jax.random.bits(kc[0], (Q, n_t * K, 5), dtype=jnp.uint32)
+        ci = jax.random.randint(kc[1], (Q, n_t * K), 0, N, dtype=jnp.int32)
+
+        def merge_body(q, cd, ci):
+            # perturb indices by the rep counter via q's first column so
+            # reps stay distinct; inv=0 (all candidates valid)
+            cj = ci ^ (q[:, :1] & 1).astype(jnp.int32)
+            d, i, inv = select_topk(cd, cj, jnp.zeros_like(cj), K)
+            return jnp.sum(i.astype(jnp.float32)) * 1e-9
+
+        # sub-ms workload: deep rep chains lift the slope above the
+        # tunnel noise floor (shallow chains measured non-monotonic)
+        mdt = chain_slope(merge_body, queries, cd, ci, r1=64, r2=512)
+        merge_ms[n_t] = round(mdt * 1e3, 2)
+        del cd, ci
+
+    return {"metric": "config5 sharded lookup, %d device(s), %d queries x "
+                      "%d ids (device-serialized chain slope%s); ICI merge "
+                      "model: wire = n_t*%d*24 B/query, re-sort ms/batch "
+                      "%s (measured vs n_t)"
+                      % (n_dev, Q, N,
+                         "" if cert_frac is None
+                         else ", certified %.5f" % cert_frac,
+                         K, json.dumps(merge_ms, sort_keys=True)),
             "value": round(Q / dt, 1), "unit": "lookups/s",
             "vs_baseline": None}
 
@@ -255,11 +407,23 @@ def main(argv=None) -> int:
     p.add_argument("-N", type=int, default=0,
                    help="config3: network size (default 10M on device)")
     p.add_argument("--chunk", type=int, default=0,
-                   help="config3: lookups per device wave")
+                   help="config3: lookups per device wave (not used "
+                        "with --tp: the tp engine runs one batch)")
+    p.add_argument("--tp", action="store_true",
+                   help="config3: shard the table over the mesh t axis "
+                        "(tp_simulate_lookups) instead of replicating it")
+    p.add_argument("--limbs", type=int, default=0,
+                   help="config3: distance limbs carried through the "
+                        "merge sorts (2 = fast default, 5 = exact-order)")
     args = p.parse_args(argv)
     todo = [args.config] if args.config else sorted(CONFIGS)
     for c in todo:
-        kw = ({"Q": args.Q, "N": args.N, "chunk": args.chunk}
+        if c == 3 and args.tp:
+            print(json.dumps(config3_tp(Q=args.Q, N=args.N,
+                                        limbs=args.limbs)))
+            continue
+        kw = ({"Q": args.Q, "N": args.N, "chunk": args.chunk,
+               "limbs": args.limbs}
               if c == 3 else {})
         print(json.dumps(CONFIGS[c](**kw)))
     return 0

@@ -141,7 +141,8 @@ def _common_bits_planar(a_l, b_l):
 
 
 def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
-                   seed_u, *, k, alpha, search_nodes, max_hops):
+                   seed_u, *, k, alpha, search_nodes, max_hops,
+                   state_limbs: int = N_LIMBS):
     """The iterative-lookup state machine, abstracted over table access.
 
     ALL access to the (possibly distributed) sorted node table flows
@@ -160,17 +161,28 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
     ``q_index``/``q_total`` are each query's GLOBAL index and the global
     batch size — the deterministic reply hash is seeded by global query
     identity, so a sharded run is bit-identical to the unsharded one.
+
+    ``state_limbs`` picks how many distance limbs the candidate state
+    carries through the per-round merge sorts: 5 (exact 160-bit
+    ordering) or 2 (rank by the top 64 distance bits only — the merge
+    sorts move 5 operands instead of 8 and the per-round reply-distance
+    gather fetches 2 planes instead of 5; bitwise identical to the
+    exact mode unless two distinct candidates tie on their top 64
+    distance bits, ~2^-58 per merge at S+R=44 rows).  Either way the
+    returned ``dist`` carries all 5 limbs (reconstructed from the final
+    node ids in one gather).
     """
     Q = targets.shape[0]
     S = search_nodes
     R = alpha * k            # reply entries merged per round
+    NL = state_limbs
 
     pos_t = lower(targets)                             # [Q], fallback replies
 
     def reply_gather(x_rows, round_no):
         """Simulated answers of the α queried nodes per search.
         x_rows [Q, alpha] int32 (−1 = no request) → node rows [Q, R]."""
-        x_l = gather_planar(x_rows)                                  # 5×[Q,a]
+        x_l = gather_planar(x_rows, N_LIMBS)     # full ids: cb is exact
         t_l = [targets[:, l:l + 1] for l in range(N_LIMBS)]
         b = _common_bits_planar(x_l, t_l)                            # [Q,a]
         prefix_len = jnp.clip(b + 1, 0, ID_BITS)
@@ -206,11 +218,12 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
     def merge(cand_node, cand_l, queried, new_rows):
         """Insert replies, dedupe by node, keep the S closest
         (↔ Search::insertNode, src/search.h:636-722).  ``cand_l`` is the
-        candidate distance as 5 limb planes [Q, S]; everything stays 2-D."""
-        new_l = gather_planar(new_rows)                           # 5×[Q,R]
+        candidate distance as NL limb planes [Q, S]; everything stays
+        2-D."""
+        new_l = gather_planar(new_rows, NL)                       # NL×[Q,R]
         node = jnp.concatenate([cand_node, new_rows], axis=1)     # [Q,S+R]
         d_l = [jnp.concatenate([cand_l[l], new_l[l] ^ targets[:, l:l + 1]],
-                               axis=1) for l in range(N_LIMBS)]
+                               axis=1) for l in range(NL)]
         qd = jnp.concatenate([queried, jnp.zeros((Q, R), jnp.int32)], axis=1)
         inv = (node < 0).astype(jnp.int32)
         # new entries beyond the valid table (padded fallback rows for
@@ -221,25 +234,25 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         # sort by (invalid, dist, node, not-queried) so that among
         # duplicates of a node the already-queried copy comes first
         out = lax.sort(
-            (inv, d_l[0], d_l[1], d_l[2], d_l[3], d_l[4], node, 1 - qd),
-            dimension=1, num_keys=8,
+            (inv,) + tuple(d_l) + (node, 1 - qd),
+            dimension=1, num_keys=3 + NL,
         )
-        inv_s, node_s = out[0], out[6]
-        qd_s = 1 - out[7]
+        inv_s, node_s = out[0], out[1 + NL]
+        qd_s = 1 - out[2 + NL]
         # dedupe: same node appears adjacently (same dist); drop repeats
         dup = jnp.concatenate(
             [jnp.zeros((Q, 1), bool),
              (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)], axis=1)
         inv2 = jnp.where(dup, 1, inv_s)
         out2 = lax.sort(
-            (inv2, out[1], out[2], out[3], out[4], out[5], node_s, 1 - qd_s),
-            dimension=1, num_keys=7,
+            (inv2,) + tuple(out[1:1 + NL]) + (node_s, 1 - qd_s),
+            dimension=1, num_keys=2 + NL,
         )
         present = out2[0][:, :S] == 0
-        node_f = jnp.where(present, out2[6][:, :S], -1)
+        node_f = jnp.where(present, out2[1 + NL][:, :S], -1)
         d_f = [jnp.where(present, out2[1 + l][:, :S], big)
-               for l in range(N_LIMBS)]
-        qd_f = (1 - out2[7])[:, :S] * present
+               for l in range(NL)]
+        qd_f = (1 - out2[2 + NL])[:, :S] * present
         return node_f, d_f, qd_f
 
     # -- bootstrap: cold start from ONE pseudo-random bootstrap peer per
@@ -251,7 +264,7 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
             (_mix32(q_index.astype(_U32) ^ seed_u)
              % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32)))
     cand_node = jnp.full((Q, S), -1, jnp.int32)
-    cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(N_LIMBS)]
+    cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(NL)]
     queried = jnp.zeros((Q, S), jnp.int32)
     first = reply_gather(boot, jnp.int32(0))
     cand_node, cand_l, queried = merge(cand_node, cand_l, queried, first)
@@ -307,9 +320,20 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
     cand_node, cand_l, queried, hops, done, _ = \
         lax.while_loop(cond, body, state)
 
+    nodes_k = cand_node[:, :k]
+    if NL == N_LIMBS:
+        dist = jnp.stack([cl[:, :k] for cl in cand_l], axis=-1)
+    else:
+        # reconstruct the full 160-bit distances from the final node ids
+        # in ONE gather — the merge loop never carried limbs 2-4
+        id_l = gather_planar(nodes_k, N_LIMBS)
+        dist = jnp.stack(
+            [jnp.where(nodes_k >= 0, id_l[l] ^ targets[:, l:l + 1],
+                       jnp.uint32(0xFFFFFFFF)) for l in range(N_LIMBS)],
+            axis=-1)
     return {
-        "nodes": cand_node[:, :k],
-        "dist": jnp.stack([cl[:, :k] for cl in cand_l], axis=-1),
+        "nodes": nodes_k,
+        "dist": dist,
         "hops": hops,
         "converged": synced(cand_node, queried) & ~empty,
     }
@@ -317,12 +341,13 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "alpha", "search_nodes", "max_hops"),
+    static_argnames=("k", "alpha", "search_nodes", "max_hops",
+                     "state_limbs"),
 )
 def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
                      k: int = TARGET_NODES, alpha: int = ALPHA,
                      search_nodes: int = SEARCH_NODES, max_hops: int = 48,
-                     lut=None):
+                     lut=None, state_limbs: int = N_LIMBS):
     """Run Q iterative lookups to convergence against an N-node network.
 
     Args:
@@ -341,6 +366,10 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     table-sharded multi-chip form (table rows partitioned over a mesh
     axis, exceeding one chip's HBM) is
     ``parallel.tp_simulate_lookups`` — same engine, same results.
+    ``state_limbs=2`` ranks merge candidates by the top 64 distance
+    bits only (5-operand merge sorts instead of 8 — see
+    :func:`_lookup_engine`); bitwise identical to the default absent
+    64-bit distance ties.
     """
     N = sorted_ids.shape[0]
     Q = targets.shape[0]
@@ -367,16 +396,17 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     # bounded in-bucket budget, else full-depth search (lax.cond)
     lower = _guarded_lower_bound(sorted_ids, n, lut)
 
-    def gather_planar(rows):
-        """rows [...] int32 → list of 5 limb arrays shaped like rows."""
+    def gather_planar(rows, limbs=N_LIMBS):
+        """rows [...] int32 → list of `limbs` limb arrays shaped like
+        rows (top limbs first — all the merge ranking needs)."""
         cl = jnp.clip(rows, 0, N - 1).reshape(-1)
-        g = jnp.take(sorted_t, cl, axis=1)             # [5, M]
-        return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
+        g = jnp.take(sorted_t[:limbs], cl, axis=1)     # [limbs, M]
+        return [g[l].reshape(rows.shape) for l in range(limbs)]
 
     return _lookup_engine(gather_planar, lower, n, targets,
                           jnp.arange(Q, dtype=jnp.int32), Q, seed_u,
                           k=k, alpha=alpha, search_nodes=search_nodes,
-                          max_hops=max_hops)
+                          max_hops=max_hops, state_limbs=state_limbs)
 
 
 # ---------------------------------------------------------------------------

@@ -82,9 +82,14 @@ LUT_BITS = 16
 
 def default_lut_bits(n_rows: int) -> int:
     """Prefix width for :func:`build_prefix_lut` sized to the table:
-    20 bits (~1-row buckets at 1M rows, 4 MiB LUT) once the table is
-    big enough to amortize it, else the 16-bit default."""
-    return 20 if n_rows >= (1 << 18) else 16
+    ~1-row buckets (bits ≈ log2 N), clamped to [16, 24].  Keeping the
+    average bucket ≈ 1 row is what makes the LUT-only (0-step)
+    positioning mode safe: positioning error is bounded by bucket size,
+    and the expanded window's stride-wide margin absorbs it (a 64M-row
+    table at 20 bits has ~61-row average buckets — comparable to the
+    margin itself — while 24 bits brings them to ~4).  The 24-bit cap
+    costs a 64 MiB LUT — noise next to the expanded table."""
+    return min(24, max(16, math.ceil(math.log2(max(n_rows, 2)))))
 # binary-search depth inside one LUT bucket: buckets of a 2^16-way
 # partition of N uniform ids are ~N/2^16 rows; 4096 (2^12) is a huge
 # overshoot for any realistic N, and an adversarial bucket larger than
@@ -340,6 +345,60 @@ def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE):
         planes.append(jnp.concatenate(
             [Bl[:NB], Bl[1:NB + 1], Bl[2:NB + 2], Bl[3:NB + 3, :2]], axis=1))
     return jnp.concatenate(planes, axis=1)
+
+
+def expand_table_chunked(sorted_ids, *, stride: int = EXPAND_STRIDE,
+                         chunks: int = 8):
+    """Same window-row table as :func:`expand_table`, built in
+    ``chunks`` pieces with a donated in-place row update.
+
+    :func:`expand_table`'s one-shot build peaks at ~2.5× the output
+    size (padded copy + per-limb planes + the concatenated result live
+    together), which OOMs a 64M-id table (3.9 GB output) on this
+    chip's effective HBM.  Here each piece covers NB/chunks output
+    rows (one gather from the sorted table with sentinel masking at
+    the edges), and ``lax.dynamic_update_slice`` with a donated
+    destination keeps exactly one output-sized buffer alive — peak =
+    output + input + one piece.
+
+    The result may carry a few zero-padded trailing rows (NB rounded
+    up to a multiple of ``chunks``); lookups never touch them (the
+    ``jmax`` clamp in :func:`expanded_topk` is bounded by ``n_valid``).
+    Bit-identical to ``expand_table`` on the common rows
+    (tests/test_topk.py).
+    """
+    N = sorted_ids.shape[0]
+    NB = -(-N // stride)
+    NBc = -(-NB // chunks)
+    erow = 3 * stride + 2
+    src_rows = (NBc + 3) * stride          # per-piece source span
+
+    @jax.jit
+    def build_piece(sorted_ids, start):
+        # rows [start, start+src_rows) of the sentinel-padded table
+        # (padded[i] = sorted[i-1]); out-of-range rows are zeros
+        idx = start + jnp.arange(src_rows, dtype=jnp.int32) - 1
+        ok = (idx >= 0) & (idx < N)
+        src = jnp.where(ok[:, None],
+                        jnp.take(sorted_ids, jnp.clip(idx, 0, N - 1),
+                                 axis=0), jnp.uint32(0))
+        planes = []
+        for l in range(N_LIMBS):
+            Bl = src[:, l].reshape(NBc + 3, stride)
+            planes.append(jnp.concatenate(
+                [Bl[:NBc], Bl[1:NBc + 1], Bl[2:NBc + 2], Bl[3:NBc + 3, :2]],
+                axis=1))
+        return jnp.concatenate(planes, axis=1)          # [NBc, 5·erow]
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def upd(out, piece, row0):
+        return lax.dynamic_update_slice(out, piece, (row0, jnp.int32(0)))
+
+    out = jnp.zeros((chunks * NBc, N_LIMBS * erow), jnp.uint32)
+    for c in range(chunks):
+        piece = build_piece(sorted_ids, jnp.int32(c * NBc * stride))
+        out = upd(out, piece, jnp.int32(c * NBc))
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps"))

@@ -208,10 +208,16 @@ def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int,
         # Certificate fallback: when any row in this shard's batch is
         # uncertified, rerun the whole shard through the exact scan and
         # keep the certified window rows.  lax.cond keeps the common
-        # (all-certified) path free of the O(shard_n) scan.
+        # (all-certified) path free of the O(shard_n) scan — but the
+        # branch's buffers are still ALLOCATED, and a 4096-row tile
+        # sorts [Q, 4104]x7 u32 temps (~7.5 GB at Q=65536), which OOMs
+        # alongside a 64M-id shard's 5 GB of resident tables.  Huge
+        # shards take a small tile: the branch only ever executes on
+        # adversarial id distributions, so its throughput is secondary
+        # to it being allocatable.
         def exact(_):
-            d2, i2 = xor_topk(q, sorted_ids, k=k,
-                              tile=min(4096, shard_n),
+            fb_tile = min(4096 if shard_n <= 8_000_000 else 512, shard_n)
+            d2, i2 = xor_topk(q, sorted_ids, k=k, tile=fb_tile,
                               valid=jnp.arange(shard_n) < n_valid)
             keep = cert[:, None]
             return (jnp.where(keep[..., None], dist, d2),
@@ -281,10 +287,18 @@ def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
 
 
 @functools.lru_cache(maxsize=16)
-def _build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
-                     alpha: int, search_nodes: int, max_hops: int,
-                     lut_bits: int):
-    """Compile the table-sharded iterative lookup for one geometry."""
+def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
+                    alpha: int, search_nodes: int, max_hops: int,
+                    lut_bits: int, state_limbs: int = N_LIMBS):
+    """Compile the table-sharded iterative lookup for one geometry.
+
+    Returns a jitted ``fn(sorted_ids, n_valid, targets, seed)`` whose
+    array inputs should be pre-placed (``sorted_ids`` P('t', None),
+    ``targets`` P('q', None)).  Public so honest benchmarks can wrap
+    the callable in a serialized rep chain (``bench.chain_slope``)
+    instead of wall-timing dispatches — :func:`tp_simulate_lookups` is
+    the convenience entry that places inputs per call.
+    """
     q_local = q_total // mesh.shape["q"]
 
     def local(sorted_shard, n_valid, targets_local, seed):
@@ -303,7 +317,7 @@ def _build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
             # shard ranges — one [M]-int32 psum over the table axis
             return lax.psum(local_lower(flat), "t")
 
-        def gather_planar(rows):
+        def gather_planar(rows, limbs=N_LIMBS):
             # distributed row fetch: the owning shard contributes the
             # row's limbs, every other shard zeros — psum reassembles.
             # Rows are pre-clipped to [0, n) by the engine; -1 (absent)
@@ -311,17 +325,18 @@ def _build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
             # masked by the engine exactly like the unsharded garbage.
             flat = (rows - base).reshape(-1)
             ok = (flat >= 0) & (flat < shard_n)
-            g = jnp.take(sorted_t, jnp.clip(flat, 0, shard_n - 1), axis=1)
+            g = jnp.take(sorted_t[:limbs], jnp.clip(flat, 0, shard_n - 1),
+                         axis=1)
             g = jnp.where(ok[None, :], g, _U32(0))
             g = lax.psum(g, "t")
-            return [g[l].reshape(rows.shape) for l in range(N_LIMBS)]
+            return [g[l].reshape(rows.shape) for l in range(limbs)]
 
         q_index = (lax.axis_index("q").astype(jnp.int32) * q_local
                    + jnp.arange(q_local, dtype=jnp.int32))
         return _lookup_engine(gather_planar, lower, n, targets_local,
                               q_index, q_total, seed.astype(_U32),
                               k=k, alpha=alpha, search_nodes=search_nodes,
-                              max_hops=max_hops)
+                              max_hops=max_hops, state_limbs=state_limbs)
 
     fn = jax.shard_map(
         local, mesh=mesh,
@@ -336,7 +351,7 @@ def _build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
 def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
                         seed: int = 0, k: int = TARGET_NODES,
                         alpha: int = ALPHA, search_nodes: int = SEARCH_NODES,
-                        max_hops: int = 48):
+                        max_hops: int = 48, state_limbs: int = N_LIMBS):
     """Iterative lookups with the sorted table ROW-SHARDED over ``t`` —
     the multi-chip north star: tables larger than one chip's HBM are
     searched iteratively, not just scanned.
@@ -372,8 +387,8 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
         raise ValueError(f"targets ({Q}) not divisible by q axis "
                          f"{mesh.shape['q']}")
     shard_n = N // n_t
-    fn = _build_tp_lookup(mesh, shard_n, Q, k, alpha, search_nodes, max_hops,
-                          default_lut_bits(shard_n))
+    fn = build_tp_lookup(mesh, shard_n, Q, k, alpha, search_nodes, max_hops,
+                         default_lut_bits(shard_n), state_limbs)
     sorted_ids = jax.device_put(jnp.asarray(sorted_ids, _U32),
                                 NamedSharding(mesh, P("t", None)))
     targets = jax.device_put(jnp.asarray(targets, _U32),

@@ -104,3 +104,23 @@ def test_scaling_hops_grow_logarithmically():
     # bigger network needs ≥ as many hops, but only logarithmically more
     assert m1[1] >= m1[0]
     assert m1[1] - m1[0] <= 6
+
+
+def test_state_limbs_2_bitwise_identical():
+    """state_limbs=2 (5-operand merge sorts ranking on the top 64
+    distance bits) must be bitwise identical to the exact engine on
+    random ids — distinct 160-bit ids tie on 64 bits with probability
+    ~2^-58 per merge, so any divergence here is a bug, not a tie."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.ops.sorted_table import sort_table
+    from opendht_tpu.core.search import simulate_lookups
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(17))
+    table = jax.random.bits(k1, (4096, 5), dtype=jnp.uint32)
+    targets = jax.random.bits(k2, (128, 5), dtype=jnp.uint32)
+    sorted_ids, _, n = sort_table(table)
+    a = simulate_lookups(sorted_ids, n, targets, seed=9)
+    b = simulate_lookups(sorted_ids, n, targets, seed=9, state_limbs=2)
+    for key in ("nodes", "hops", "converged", "dist"):
+        np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))

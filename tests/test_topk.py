@@ -474,3 +474,33 @@ def test_cascade_topk_two_stage_device_repair():
     c2_np = np.asarray(c2o)
     np.testing.assert_array_equal(np.asarray(i2o)[c2_np],
                                   np.asarray(i_ref2)[c2_np])
+
+
+@pytest.mark.parametrize("n,chunks", [(4096, 8), (4099, 4), (1000, 3)])
+def test_expand_table_chunked_matches(n, chunks):
+    """The chunked low-peak-memory builder must be bit-identical to
+    expand_table on all real rows (trailing chunk-padding rows are
+    zeros and never read — the jmax clamp is bounded by n_valid)."""
+    from opendht_tpu.ops.sorted_table import (expand_table,
+                                              expand_table_chunked,
+                                              expanded_topk,
+                                              build_prefix_lut)
+    from opendht_tpu.ops.xor_topk import xor_topk
+    rng = np.random.default_rng(57 + n)
+    raw = rng.integers(0, 256, size=(n, 20), dtype=np.uint8)
+    sorted_ids, perm, n_valid = sort_table(jnp.asarray(K.ids_from_bytes(raw)))
+    a = expand_table(sorted_ids)
+    b = expand_table_chunked(sorted_ids, chunks=chunks)
+    NB = a.shape[0]
+    assert b.shape[0] >= NB and b.shape[1] == a.shape[1]
+    np.testing.assert_array_equal(np.asarray(b)[:NB], np.asarray(a))
+    # and the padded form gives exact lookups end to end
+    q = jnp.asarray(K.ids_from_bytes(
+        rng.integers(0, 256, size=(64, 20), dtype=np.uint8)))
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    d, i, c = expanded_topk(sorted_ids, b, n_valid, q, k=8, lut=lut,
+                            lut_steps=0)
+    d_ref, i_ref = xor_topk(q, sorted_ids, k=8, valid=jnp.arange(n) < n_valid)
+    c_np = np.asarray(c)
+    np.testing.assert_array_equal(np.asarray(i)[c_np], np.asarray(i_ref)[c_np])
+    assert c_np.mean() > 0.9
