@@ -158,21 +158,27 @@ def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
 
 
 # Headline kernel geometry, selected by the round-3 per-stage profile
-# (python bench.py --profile on the v5e; all chain-slope, N=1M Q=131K):
+# (python bench.py --profile on the v5e; all chain-slope, N=1M Q=131K,
+# cascade totals include the on-device stage-2 repair):
 #   stride 64 (192-window, pads to 256 lanes in the sort): 23.6 ms
 #   stride 42 (126-window, pads to 128 — half the comparator traffic
-#              AND half the row-gather bytes):               9.3 ms
-#   stride 32 (96-window, SAME 128-lane padded sort):        6.3 ms but
-#              certification drops to 0.9987 (164 fallbacks/batch)
+#              AND half the row-gather bytes): 9.3 ms, stage-1 cert
+#              0.99997 (4 repairs/batch)
+#   stride 32 (96-window, SAME 128-lane padded sort, smaller gather):
+#              cascade 6.97 ms, stage-1 cert 0.9987 (164 repairs ≤ cap)
+#   stride 24 (72-window): stage-1 cert 0.974 → 3.4K repairs swamp
+#              stage 2; cascade 8.3 ms — past the optimum (recorded
+#              negative result)
 #   positioning: LUT-only (0 search steps) loses nothing at 20 LUT bits
-#              on 1M rows (max bucket ~8 ≪ the stride-42 margin) and
+#              on 1M rows (max bucket ~8 ≪ the window margin) and
 #              removes ~2.5 ms of serialized element-gather steps.
-# stride 42 + steps=0 certifies ~0.99997 (≈4 rows per 131K batch); the
-# timed kernel is cascade_topk, which repairs those rows on device
-# against the wide stride-64 expansion in the same call (a full-scan
-# fallback at Q=128 costs 520 ms — the tiled scan serializes ~245 tiny
-# sorts — so the cascade is both the honest and the fast design).
-HEADLINE_STRIDE = 42
+# The timed kernel is cascade_topk at stride 32 with a 256-row repair
+# cap: uncertified rows are selected on device and re-looked-up against
+# the wide stride-64 expansion in the same call (a full-scan fallback
+# at Q=128 costs 520 ms — the tiled scan serializes ~245 tiny sorts —
+# so the cascade is both the honest and the fast design).
+HEADLINE_STRIDE = 32
+HEADLINE_CAP = 256
 
 
 def measure(samples: int = 5) -> dict:
@@ -198,9 +204,11 @@ def measure(samples: int = 5) -> dict:
         # fast2 = the findClosestNodes contract (nodes, not distances):
         # the sort carries 4 operands instead of 7 (sort cost is linear
         # in operand count); cascade_topk includes the on-device repair
-        # of the ~4/131K rows the narrow window fails to certify
+        # of the ~164/131K rows the stride-32 window fails to certify
+        # (HEADLINE_CAP bounds the repair batch)
         d, idx, c = cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid,
-                                 q, lut, k=K, select="fast2")
+                                 q, lut, k=K, select="fast2",
+                                 cap=HEADLINE_CAP)
         return (jnp.sum(c.astype(jnp.float32))
                 + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
 
@@ -220,7 +228,7 @@ def measure(samples: int = 5) -> dict:
                       select="fast2", lut=lut, lut_steps=0))
     _, i2, cert = jax.block_until_ready(
         cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries,
-                     lut, k=K, select="fast2"))
+                     lut, k=K, select="fast2", cap=HEADLINE_CAP))
     cert_np = np.asarray(cert)
     cert_frac = float(cert_np.mean())
     stage2_rows = int((~np.asarray(cert1)).sum())

@@ -111,8 +111,9 @@ def build_prefix_lut(sorted_ids, n_valid, *, bits: int = LUT_BITS):
     end) get the sentinel prefix 2^bits so every real prefix resolves
     below n_valid.  Returns int32 [2^bits + 1]; entry [p+1] bounds
     bucket p.  ``bits`` is recoverable from the result shape, so
-    consumers infer it — pass 20 for million-row tables (4 MiB LUT,
-    ~1-row buckets) and keep the 16-bit default for small ones.
+    consumers infer it — size it with :func:`default_lut_bits`
+    (~1-row buckets at any N, which is what keeps the LUT-only 0-step
+    positioning mode inside the expanded window's margin).
     """
     N = sorted_ids.shape[0]
     keys = (sorted_ids[:, 0] >> jnp.uint32(32 - bits)).astype(jnp.int32)

@@ -199,3 +199,24 @@ def test_sharded_expanded_lookup_matches_full_scan(mesh):
                                            lut=lut)
         np.testing.assert_array_equal(np.asarray(d_sh), np.asarray(d_ref))
         np.testing.assert_array_equal(np.asarray(rows), np.asarray(i_ref))
+
+
+@pytest.mark.parametrize("q,t", [(1, 8), (4, 2), (8, 1)])
+def test_tp_simulate_mesh_geometries(q, t):
+    """The table-sharded engine must be exact for ANY mesh split — pure
+    table-parallel (q=1), query-heavy (q=4,t=2), and the degenerate
+    single-shard (t=1) all reduce to the same bit-exact results."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    m = make_mesh(8, q=q, t=t)
+    rng = np.random.default_rng(40 + q)
+    ids = _rand_ids(rng, 2048)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = _rand_ids(rng, 8 * q)
+
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=4)
+    out = tp_simulate_lookups(m, np.asarray(sorted_ids), n_valid,
+                              targets, seed=4)
+    for key in ("nodes", "hops", "converged"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]))
