@@ -85,16 +85,25 @@ def _increment(ids):
 def _prefix_block_bounds(lower, n, targets, prefix_len):
     """[lo, ub) sorted-index range of ids sharing `prefix_len` leading bits
     with each target.  ``lower``: flat [M,5] → [M] lower-bound positions;
-    targets [..., 5]; prefix_len [...] int32."""
+    targets [..., 5]; prefix_len [...] int32.
+
+    Both block edges go through ONE batched ``lower`` call: the search
+    is a fixed number of SEQUENTIAL gather steps, so two M-row calls
+    cost twice the serial latency of one 2M-row call (per-element
+    gathers are issue-bound, and each step's gather is latency-, not
+    bandwidth-, limited at these sizes)."""
     masks = jnp.take(jnp.asarray(_PREFIX_MASKS),
                      jnp.clip(prefix_len, 0, ID_BITS), axis=0)
     p_lo = targets & masks
-    p_hi = p_lo | ~masks
-    lo = lower(p_lo.reshape(-1, N_LIMBS)).reshape(targets.shape[:-1])
-    ub = lower(_increment(p_hi).reshape(-1, N_LIMBS)
-               ).reshape(targets.shape[:-1])
+    p_hi_inc = _increment(p_lo | ~masks)
+    both = jnp.concatenate([p_lo.reshape(-1, N_LIMBS),
+                            p_hi_inc.reshape(-1, N_LIMBS)], axis=0)
+    pos = lower(both)
+    M = both.shape[0] // 2
+    lo = pos[:M].reshape(targets.shape[:-1])
+    ub = pos[M:].reshape(targets.shape[:-1])
     # p_hi of all-ones wraps to zero on increment → block extends to n
-    wrapped = jnp.all(_increment(p_hi) == 0, axis=-1)
+    wrapped = jnp.all(p_hi_inc == 0, axis=-1)
     ub = jnp.where(wrapped, n, ub)
     return lo, ub
 
@@ -290,14 +299,11 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         can = (cand_node >= 0) & (queried == 0) & ~done[:, None]
         rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
         sel = can & (rank <= alpha)
-        # gather selected rows into [Q, alpha] (−1 pad)
-        sel_rank = jnp.where(sel, rank - 1, S)
-        x_rows = jnp.full((Q, alpha + 1), -1, jnp.int32)
-        x_rows = x_rows.at[
-            jnp.arange(Q)[:, None].repeat(S, 1).reshape(-1),
-            jnp.minimum(sel_rank, alpha).reshape(-1),
-        ].max(jnp.where(sel, cand_node, -1).reshape(-1))
-        x_rows = x_rows[:, :alpha]
+        # gather selected rows into [Q, alpha] (−1 pad): α static masked
+        # max-reductions — a scatter-max here measured slower on TPU
+        x_rows = jnp.stack(
+            [jnp.max(jnp.where(sel & (rank == j + 1), cand_node, -1),
+                     axis=1) for j in range(alpha)], axis=1)
 
         new_rows = reply_gather(x_rows, round_no + 1)
         queried = jnp.where(sel, 1, queried)
