@@ -14,7 +14,8 @@ from opendht_tpu.tools.dhtscanner import main as c
 print("entry points ok")
 PY
 python -m pytest tests/ -q
-# README/PARITY must quote the last accelerator bench capture verbatim
+# README/PARITY headline quotes must agree with the last accelerator
+# bench capture (within the stated cross-run drift band)
 python ci/check_docs.py
 python - <<'PY'
 import os
@@ -47,4 +48,21 @@ m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)
 for c in (1, 3, 4, 5):
     m.main(["-c", str(c)])
+PY
+# table-sharded iterative mode on a REAL 8-device virtual mesh (the
+# in-process provisioning must happen before the first jax import, so
+# this gets its own interpreter)
+python - <<'PY'
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib
+spec = importlib.util.spec_from_file_location(
+    "baseline_configs", pathlib.Path("benchmarks/baseline_configs.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+assert len(jax.devices()) == 8
+m.main(["-c", "3", "--tp", "-N", "65536", "-Q", "1024"])
 PY
