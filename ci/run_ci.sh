@@ -49,9 +49,12 @@ spec.loader.exec_module(m)
 for c in (1, 3, 4, 5):
     m.main(["-c", str(c)])
 PY
-# table-sharded iterative mode on a REAL 8-device virtual mesh (the
-# in-process provisioning must happen before the first jax import, so
-# this gets its own interpreter)
+# table-sharded iterative mode on a REAL 8-device virtual mesh.  The
+# heredoc (rather than env vars + the module CLI) is deliberate: on
+# hosts that register an accelerator backend via sitecustomize, the
+# JAX_PLATFORMS env var alone LOSES to the registration hook — only a
+# jax.config.update before first backend use wins, and the 8-device
+# flag must land before the first jax import.
 python - <<'PY'
 import os
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")

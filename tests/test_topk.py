@@ -504,3 +504,71 @@ def test_expand_table_chunked_matches(n, chunks):
     c_np = np.asarray(c)
     np.testing.assert_array_equal(np.asarray(i)[c_np], np.asarray(i_ref)[c_np])
     assert c_np.mean() > 0.9
+
+
+def test_fuzz_kernel_geometries_certified_rows_exact():
+    """Randomized sweep: random VALID counts (including < k and == k),
+    random invalid fractions, duplicate ids, query hits, across strides
+    and select modes — certified rows must ALWAYS equal the full-scan
+    oracle, and lookup_topk must always repair to exactness.
+
+    Shapes are FIXED (table slab 3000 rows, 64 queries; randomness
+    lives in the valid mask / data) so the ~90 kernel invocations reuse
+    a handful of compiles instead of recompiling per trial — the
+    shape-per-trial version of this test spent ~5 min in XLA.
+    """
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
+                                              expanded_topk, cascade_topk)
+    from opendht_tpu.ops.xor_topk import xor_topk
+    rng = np.random.default_rng(2026)
+    NSLAB, NQ = 3000, 64
+    for trial in range(10):
+        n = int(rng.integers(3, NSLAB))
+        kk = int(rng.choice([8, 16]))
+        stride = int(rng.choice([24, 32, 42, 64]))
+        raw = rng.integers(0, 256, size=(NSLAB, 20), dtype=np.uint8)
+        if trial % 3 == 0:
+            raw[: NSLAB // 3] = raw[0]        # duplicate ids
+        valid = np.zeros(NSLAB, bool)
+        valid[rng.permutation(NSLAB)[:n]] = True
+        if trial % 4 == 1:
+            valid &= rng.random(NSLAB) > 0.9  # very sparse
+        ids = jnp.asarray(K.ids_from_bytes(raw))
+        sorted_ids, perm, n_valid = sort_table(ids, jnp.asarray(valid))
+        lut = build_prefix_lut(sorted_ids, n_valid)
+        exp = expand_table(sorted_ids, stride=stride)
+        q_raw = rng.integers(0, 256, size=(NQ, 20), dtype=np.uint8)
+        q_raw[: NQ // 2] = raw[rng.integers(0, NSLAB, NQ // 2)]  # hits
+        q = jnp.asarray(K.ids_from_bytes(q_raw))
+        d_ref, i_ref = xor_topk(q, sorted_ids, k=kk,
+                                valid=jnp.arange(NSLAB) < n_valid)
+        for select in ("fast2", "fast3", "sort"):
+            for steps in (None, 0):
+                d, i, c = expanded_topk(sorted_ids, exp, n_valid, q, k=kk,
+                                        select=select, lut=lut,
+                                        lut_steps=steps)
+                c_np = np.asarray(c)
+                ctx = (trial, n, kk, stride, select, steps)
+                np.testing.assert_array_equal(
+                    np.asarray(i)[c_np], np.asarray(i_ref)[c_np],
+                    err_msg=str(ctx))
+                if d is not None:
+                    np.testing.assert_array_equal(
+                        np.asarray(d)[c_np], np.asarray(d_ref)[c_np],
+                        err_msg=str(ctx))
+            # full repair contract (device-cond fallback path)
+            _, i_full, c_full = lookup_topk(sorted_ids, n_valid, q, k=kk,
+                                            lut=lut, expanded=exp,
+                                            select=select)
+            assert bool(np.asarray(c_full).all()), ctx
+            np.testing.assert_array_equal(np.asarray(i_full),
+                                          np.asarray(i_ref), err_msg=str(ctx))
+        # cascade with a second (wide) expansion
+        if stride != 64:
+            exp64 = expand_table(sorted_ids)
+            d2, i2, c2 = cascade_topk(sorted_ids, exp, exp64, n_valid, q,
+                                      lut, k=kk, select="fast2", cap=64)
+            c2_np = np.asarray(c2)
+            np.testing.assert_array_equal(
+                np.asarray(i2)[c2_np], np.asarray(i_ref)[c2_np],
+                err_msg=str((trial, "cascade")))
