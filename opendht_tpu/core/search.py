@@ -50,8 +50,9 @@ from jax import lax
 
 from ..ops.ids import N_LIMBS, ID_BITS, ids_to_bytes, clz32
 from ..ops.radix import _PREFIX_MASKS
-from ..ops.sorted_table import (_lower_bound, _lut_bits, build_prefix_lut,
-                                default_lut_bits, lut_budget_steps)
+from ..ops.sorted_table import (_lex_lt, _lower_bound, _lut_bits,
+                                build_prefix_lut, default_lut_bits,
+                                lut_budget_steps)
 
 _U32 = jnp.uint32
 
@@ -119,20 +120,57 @@ def _guarded_lower_bound(sorted_ids, n, lut):
     simulation to catch it, so the guard makes the LUT path *sound*
     rather than merely fast: ``max(diff(lut))`` bounds every bucket, and
     oversized tables simply pay the log2(N)-step search.
+
+    The fast path additionally searches on the TOP 64 BITS only (the
+    probe-step gather is per-element issue-bound — ~70% of the whole
+    search-sim round was these gathers at 5 limbs) and then restores
+    the exact 160-bit answer with ONE full-width compare: when no two
+    ADJACENT valid rows share their top 64 bits (checked on device in
+    one scan), at most one row can satisfy row64 == q64, so the 160-bit
+    lower bound is the 64-bit one plus at most 1 —
+    ``lb160 = lb64 + (row[lb64] < q)``.  Tables violating the
+    precondition (64-bit duplicate neighbors) take the full 5-limb
+    search instead — exactness never depends on probabilistic
+    assumptions.
     """
+    N = sorted_ids.shape[0]
     # same budget _lower_bound will actually use (ONE shared definition)
-    steps = lut_budget_steps(sorted_ids.shape[0], _lut_bits(lut))
+    steps = lut_budget_steps(N, _lut_bits(lut))
     # a B-row bucket needs ceil(log2 B)+1 search steps; with `steps`
     # available, buckets up to 2^(steps-1) rows are provably covered
     lut_ok = jnp.max(lut[1:] - lut[:-1]) <= jnp.int32(
         1 << min(steps - 1, 30))
+    nn = jnp.asarray(n, jnp.int32)
+    s0, s1 = sorted_ids[:, 0], sorted_ids[:, 1]
+    if N > 1:
+        adj_valid = (jnp.arange(N - 1, dtype=jnp.int32) + 1) < nn
+        tie64 = jnp.any((s0[1:] == s0[:-1]) & (s1[1:] == s1[:-1])
+                        & adj_valid)
+    else:
+        tie64 = jnp.bool_(False)
+    sorted_t_full = sorted_ids.T
+
+    def fast(q):
+        lb = _lower_bound(sorted_ids, q, n, lut=lut, lut_steps=None,
+                          limbs=2)
+        g = jnp.take(sorted_t_full, jnp.clip(lb, 0, N - 1), axis=1)
+        lt = _lex_lt(g, [q[:, l] for l in range(N_LIMBS)], N_LIMBS)
+        return jnp.minimum(lb + (lt & (lb < nn)).astype(jnp.int32), nn)
 
     def lower(flat):
+        # three tiers: 64-bit search + exact correction (needs tie-free
+        # top-64 neighbors) → full-limb LUT-bounded search (sound for
+        # any data as long as buckets fit the budget) → full-depth
+        # un-LUT'd search (always sound)
         return lax.cond(
-            lut_ok,
-            lambda q: _lower_bound(sorted_ids, q, n, lut=lut,
-                                   lut_steps=None),
-            lambda q: _lower_bound(sorted_ids, q, n),
+            lut_ok & ~tie64,
+            fast,
+            lambda q: lax.cond(
+                lut_ok,
+                lambda q2: _lower_bound(sorted_ids, q2, n, lut=lut,
+                                        lut_steps=None),
+                lambda q2: _lower_bound(sorted_ids, q2, n),
+                q),
             flat)
     return lower
 

@@ -137,14 +137,33 @@ def lut_budget_steps(n_rows: int, bits: int) -> int:
     return max(6, math.ceil(math.log2(max(n_rows, 2))) - bits + 6)
 
 
+def _lex_lt(g, q_l, limbs: int):
+    """Planar lexicographic row < query over ``limbs`` uint32 planes:
+    ``g`` [limbs, M] gathered rows, ``q_l`` list of [M] query limbs.
+    THE single definition — used by the binary-search probe step here
+    and by the exact-correction step in core/search.py."""
+    lt = g[limbs - 1] < q_l[limbs - 1]
+    for l in range(limbs - 2, -1, -1):
+        lt = (g[l] < q_l[l]) | ((g[l] == q_l[l]) & lt)
+    return lt
+
+
 def _lower_bound(sorted_ids, queries, n_valid, lut=None,
-                 lut_steps: int = LUT_BUCKET_STEPS):
+                 lut_steps: int = LUT_BUCKET_STEPS,
+                 limbs: int = N_LIMBS):
     """First index i in [0, n_valid] with sorted_ids[i] >= q, batched.
 
     Fixed-depth binary search (static ceil(log2 N)+1 steps) — no
     data-dependent control flow, so it stays one fused XLA loop.  With a
     prefix ``lut`` (build_prefix_lut) the search starts inside the
     query's 2^16-way bucket and needs only LUT_BUCKET_STEPS steps.
+
+    ``limbs`` restricts the comparison to the top ``limbs`` uint32
+    limbs (the probe-step gather is the dominant cost — it is
+    per-element issue-bound, so 2 limbs cost 2/5 of 5).  The result is
+    then the lower bound in the TRUNCATED key order; see
+    core/search.py ``_guarded_lower_bound`` for the exact-correction
+    construction (truncated search + one full-width compare step).
     """
     N = sorted_ids.shape[0]
     Q = queries.shape[0]
@@ -165,18 +184,14 @@ def _lower_bound(sorted_ids, queries, n_valid, lut=None,
     # gather probe rows limb-planar from the transposed table: a [Q, 5]
     # row gather pads 5 lanes → 128 in TPU tiled layout; [5, Q] columns
     # stay unpadded and the lex compare runs on 1-D planes
-    sorted_t = sorted_ids.T                                  # [5, N]
-    q_l = [queries[:, l] for l in range(N_LIMBS)]
+    sorted_t = sorted_ids.T[:limbs]                          # [limbs, N]
+    q_l = [queries[:, l] for l in range(limbs)]
 
     def body(_, lohi):
         lo, hi = lohi
         mid = (lo + hi) // 2
-        g = jnp.take(sorted_t, jnp.clip(mid, 0, N - 1), axis=1)   # [5, Q]
-        # mid < q, 5-limb lexicographic, planar
-        lt = g[N_LIMBS - 1] < q_l[N_LIMBS - 1]
-        for l in range(N_LIMBS - 2, -1, -1):
-            lt = (g[l] < q_l[l]) | ((g[l] == q_l[l]) & lt)
-        go_right = lt & (lo < hi)
+        g = jnp.take(sorted_t, jnp.clip(mid, 0, N - 1), axis=1)  # [limbs, Q]
+        go_right = _lex_lt(g, q_l, limbs) & (lo < hi)
         new_lo = jnp.where(go_right, mid + 1, lo)
         new_hi = jnp.where(go_right | (lo >= hi), hi, mid)
         return new_lo, new_hi

@@ -124,3 +124,50 @@ def test_state_limbs_2_bitwise_identical():
     b = simulate_lookups(sorted_ids, n, targets, seed=9, state_limbs=2)
     for key in ("nodes", "hops", "converged", "dist"):
         np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+
+
+def test_guarded_lower_bound_exact_incl_tie64_tables():
+    """_guarded_lower_bound's three tiers (64-bit search + one-compare
+    correction / full-limb LUT search / full-depth search) must all be
+    EXACT vs the reference full-width binary search — on random ids, on
+    tables with adjacent top-64 duplicates (the tie64 guard's reason to
+    exist), and on heavily clustered ids (LUT-bucket overflow)."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              _lower_bound)
+    from opendht_tpu.core.search import _guarded_lower_bound
+
+    rng = np.random.default_rng(64)
+
+    def check(ids_np, probes_np, label):
+        sorted_ids, _, n = sort_table(jnp.asarray(ids_np))
+        lut = build_prefix_lut(sorted_ids, n)
+        lower = _guarded_lower_bound(sorted_ids, n, lut)
+        got = np.asarray(lower(jnp.asarray(probes_np)))
+        want = np.asarray(_lower_bound(sorted_ids, jnp.asarray(probes_np),
+                                       n))
+        np.testing.assert_array_equal(got, want, err_msg=label)
+
+    base = rng.integers(0, 2**32, size=(2048, 5), dtype=np.uint32)
+    # probes: random + exact row hits + rows +/- 1 in the last limb
+    probes = rng.integers(0, 2**32, size=(256, 5), dtype=np.uint32)
+    probes[:64] = base[rng.integers(0, 2048, 64)]
+    probes[64:96] = base[rng.integers(0, 2048, 32)]
+    probes[64:96, 4] += 1
+    probes[96:128] = base[rng.integers(0, 2048, 32)]
+    probes[96:128, 4] -= 1
+    check(base, probes, "random")
+
+    dup = base.copy()
+    dup[100:140, :2] = dup[100, :2]       # 40 rows share top 64 bits
+    check(dup, probes, "tie64")
+    dup2 = base.copy()
+    dup2[:300] = dup2[0]                  # full duplicate ids
+    check(dup2, probes, "full-dup")
+
+    clus = base.copy()
+    clus[:1800, 0] = 0x7777AAAA           # LUT bucket overflow
+    p2 = probes.copy()
+    p2[:128, 0] = 0x7777AAAA
+    check(clus, p2, "clustered")
