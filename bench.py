@@ -380,7 +380,7 @@ def profile(N: int = None, Q: int = None) -> list:
         return body
 
     for name, expd, steps, select in [
-        ("full fast2 s=64 steps=6 (r2 headline)", exp64, 6, "fast2"),
+        ("full fast2 s=64 steps=6 (r2-era geometry)", exp64, 6, "fast2"),
         ("full fast2 s=64 steps=0", exp64, 0, "fast2"),
         ("full fast2 s=32 steps=6", exp32, 6, "fast2"),
         ("full fast2 s=32 steps=0", exp32, 0, "fast2"),
@@ -394,6 +394,17 @@ def profile(N: int = None, Q: int = None) -> list:
                float(np.asarray(c).mean())}
         print(json.dumps(rec), flush=True)
         out.append(rec)
+
+    # the full headline pipeline (stage-1 fast path + on-device repair)
+    def casc_body(q, sorted_ids, e32, e64, n_valid, lut):
+        d, idx, c = cascade_topk(sorted_ids, e32, e64, n_valid, q, lut,
+                                 k=K, select="fast2", cap=HEADLINE_CAP)
+        return (jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
+
+    r1c, r2c = (8, 64) if on_accel else (2, 8)
+    stage("cascade s=32 cap=%d (headline)" % HEADLINE_CAP, casc_body,
+          sorted_ids, exp32, exp64, n_valid, lut, r1=r1c, r2=r2c)
     return out
 
 

@@ -142,7 +142,10 @@ class OpCache:
         return TIME_MAX if self._listeners else self._last_removed + OP_LINGER
 
     def is_expired(self, now: float) -> bool:
-        return not self._listeners and self.get_expiration() < now
+        # inclusive boundary, matching SearchCache.expire: an op whose
+        # linger ends exactly now IS expired (a strict '<' here would
+        # re-inherit the exp == now virtual-clock live-lock)
+        return not self._listeners and self.get_expiration() <= now
 
     def get(self, f: Optional[Filter] = None) -> List[Value]:
         return self.cache.get(f)
@@ -199,13 +202,23 @@ class SearchCache:
         self._ops.clear()
 
     def expire(self, now: float, on_cancel: Callable[[int], None]) -> float:
-        """Drop ops past their linger; returns next expiration
-        (op_cache.cpp:161-178)."""
+        """Drop ops whose linger has elapsed; returns next expiration
+        (op_cache.cpp:161-178).
+
+        Boundary is INCLUSIVE (``exp <= now``), unlike the reference's
+        strict ``<``: the expire job re-arms itself at the returned
+        time, and a surviving op with ``exp == now`` would re-arm the
+        job at the CURRENT instant — a live-lock under a virtual clock
+        that only advances between events (observed: a search's
+        expire_ops job spinning at one timestamp until the test
+        harness's event budget drained).  Real monotonic clocks advance
+        between scheduler runs, which is the only reason the strict
+        form terminates in the reference."""
         self._next_expiration = TIME_MAX
         for q in list(self._ops):
             op = self._ops[q]
             exp = op.get_expiration()
-            if exp < now:
+            if exp <= now:
                 del self._ops[q]
                 on_cancel(op.search_token)
             else:
