@@ -206,7 +206,9 @@ def _common_bits_planar(a_l, b_l):
 
 def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
                    seed_u, *, k, alpha, search_nodes, max_hops,
-                   state_limbs: int = N_LIMBS):
+                   state_limbs: int = N_LIMBS,
+                   compact_after: "int | None" = None,
+                   compact_cap: int = 0):
     """The iterative-lookup state machine, abstracted over table access.
 
     ALL access to the (possibly distributed) sorted node table flows
@@ -235,31 +237,45 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
     distance bits, ~2^-58 per merge at S+R=44 rows).  Either way the
     returned ``dist`` carries all 5 limbs (reconstructed from the final
     node ids in one gather).
+
+    ``compact_after`` (static round count) enables SURVIVOR COMPACTION:
+    after that many rounds the (typically small) set of unconverged
+    searches is packed into a ``compact_cap``-wide sub-batch on device
+    (``jnp.nonzero(size=cap)`` — no host sync) and run to convergence
+    at the narrow width, then scattered back; a final full-width loop
+    resuming AT THE CUT ROUND is the safety net for cap overflow (it
+    runs ZERO iterations when the cap held).  Reply streams are keyed
+    by (global query id, round number), so results are bitwise
+    identical to the uncompacted run regardless of cap (overflow rows
+    replay exactly the rounds they were paused for); the sole
+    exception is a row still unconverged at ``max_hops``, which both
+    engines report converged=False.
     """
     Q = targets.shape[0]
     S = search_nodes
     R = alpha * k            # reply entries merged per round
     NL = state_limbs
 
-    pos_t = lower(targets)                             # [Q], fallback replies
+    pos_t_full = lower(targets)                        # [Q], fallback replies
 
-    def reply_gather(x_rows, round_no):
+    def reply_gather(tgt, pt, qidx, x_rows, round_no):
         """Simulated answers of the α queried nodes per search.
-        x_rows [Q, alpha] int32 (−1 = no request) → node rows [Q, R]."""
+        x_rows [W, alpha] int32 (−1 = no request) → node rows [W, R]."""
+        W = tgt.shape[0]
         x_l = gather_planar(x_rows, N_LIMBS)     # full ids: cb is exact
-        t_l = [targets[:, l:l + 1] for l in range(N_LIMBS)]
-        b = _common_bits_planar(x_l, t_l)                            # [Q,a]
+        t_l = [tgt[:, l:l + 1] for l in range(N_LIMBS)]
+        b = _common_bits_planar(x_l, t_l)                            # [W,a]
         prefix_len = jnp.clip(b + 1, 0, ID_BITS)
-        lo, ub = _prefix_block_bounds(lower, n, targets[:, None, :]
+        lo, ub = _prefix_block_bounds(lower, n, tgt[:, None, :]
                                       .repeat(x_rows.shape[1], 1), prefix_len)
-        size = jnp.maximum(ub - lo, 0)                                     # [Q,a]
+        size = jnp.maximum(ub - lo, 0)                                     # [W,a]
 
-        qi = q_index.astype(_U32)[:, None, None]       # GLOBAL query ids
+        qi = qidx.astype(_U32)[:, None, None]          # GLOBAL query ids
         ai = jnp.arange(x_rows.shape[1], dtype=_U32)[None, :, None]
         ji = jnp.arange(k, dtype=_U32)[None, None, :]
         ctr = (((round_no.astype(_U32) * _U32(q_total) + qi) * _U32(alpha)
                 + ai) * _U32(k) + ji) ^ seed_u
-        h = _mix32(ctr)                                                     # [Q,a,k]
+        h = _mix32(ctr)                                                     # [W,a,k]
 
         blk = lo[..., None] + (h % jnp.maximum(size[..., None], 1).astype(_U32)
                                ).astype(jnp.int32)
@@ -271,24 +287,25 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         # a uniform sample — the round-1 uniform model overestimated
         # terminal hops ~2x; validated against the live protocol path in
         # tests/test_hop_parity.py)
-        base = jnp.clip(pos_t[:, None, None] - R // 2, 0,
+        base = jnp.clip(pt[:, None, None] - R // 2, 0,
                         jnp.maximum(n - R, 0))
         fb = jnp.clip(base + (ai * _U32(k) + ji).astype(jnp.int32), 0,
                       jnp.maximum(n - 1, 0))
         rows = jnp.where((size[..., None] >= k), blk, fb)
         rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
-        return rows.reshape(Q, R)
+        return rows.reshape(W, R)
 
-    def merge(cand_node, cand_l, queried, new_rows):
+    def merge(tgt, cand_node, cand_l, queried, new_rows):
         """Insert replies, dedupe by node, keep the S closest
         (↔ Search::insertNode, src/search.h:636-722).  ``cand_l`` is the
-        candidate distance as NL limb planes [Q, S]; everything stays
+        candidate distance as NL limb planes [W, S]; everything stays
         2-D."""
-        new_l = gather_planar(new_rows, NL)                       # NL×[Q,R]
-        node = jnp.concatenate([cand_node, new_rows], axis=1)     # [Q,S+R]
-        d_l = [jnp.concatenate([cand_l[l], new_l[l] ^ targets[:, l:l + 1]],
+        W = tgt.shape[0]
+        new_l = gather_planar(new_rows, NL)                       # NL×[W,R]
+        node = jnp.concatenate([cand_node, new_rows], axis=1)     # [W,S+R]
+        d_l = [jnp.concatenate([cand_l[l], new_l[l] ^ tgt[:, l:l + 1]],
                                axis=1) for l in range(NL)]
-        qd = jnp.concatenate([queried, jnp.zeros((Q, R), jnp.int32)], axis=1)
+        qd = jnp.concatenate([queried, jnp.zeros((W, R), jnp.int32)], axis=1)
         inv = (node < 0).astype(jnp.int32)
         # new entries beyond the valid table (padded fallback rows for
         # empty/absent requests) already arrive as -1 via reply_gather;
@@ -305,7 +322,7 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         qd_s = 1 - out[2 + NL]
         # dedupe: same node appears adjacently (same dist); drop repeats
         dup = jnp.concatenate(
-            [jnp.zeros((Q, 1), bool),
+            [jnp.zeros((W, 1), bool),
              (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)], axis=1)
         inv2 = jnp.where(dup, 1, inv_s)
         out2 = lax.sort(
@@ -330,8 +347,9 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
     cand_node = jnp.full((Q, S), -1, jnp.int32)
     cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(NL)]
     queried = jnp.zeros((Q, S), jnp.int32)
-    first = reply_gather(boot, jnp.int32(0))
-    cand_node, cand_l, queried = merge(cand_node, cand_l, queried, first)
+    first = reply_gather(targets, pos_t_full, q_index, boot, jnp.int32(0))
+    cand_node, cand_l, queried = merge(targets, cand_node, cand_l, queried,
+                                       first)
 
     def synced(cand_node, queried):
         """First min(k, #candidates) candidates all answered
@@ -342,44 +360,99 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         return jnp.all(~present | (queried[:, :k] > 0), axis=1) & \
             jnp.any(present, axis=1)
 
+    def make_body(tgt, pt, qidx):
+        def body(state):
+            cand_node, cand_l, queried, hops, done, round_no = state
+            # select the closest α unqueried candidates per active search
+            # (↔ searchSendGetValues picking SearchNodes with canGet,
+            #  src/dht.cpp:628-639)
+            can = (cand_node >= 0) & (queried == 0) & ~done[:, None]
+            rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+            sel = can & (rank <= alpha)
+            # gather selected rows into [W, alpha] (−1 pad): α static
+            # masked max-reductions — a scatter-max here measured slower
+            x_rows = jnp.stack(
+                [jnp.max(jnp.where(sel & (rank == j + 1), cand_node, -1),
+                         axis=1) for j in range(alpha)], axis=1)
+
+            new_rows = reply_gather(tgt, pt, qidx, x_rows, round_no + 1)
+            queried = jnp.where(sel, 1, queried)
+            cand_node, cand_l, queried = merge(
+                tgt, cand_node, cand_l, queried, new_rows)
+
+            now_done = synced(cand_node, queried)
+            stalled = ~jnp.any((cand_node >= 0) & (queried == 0), axis=1)
+            sent = jnp.any(sel, axis=1)
+            # a stalling round sends nothing → costs no hop (matches the
+            # scalar reference's stall return path)
+            hops = jnp.where(~done & sent, hops + 1, hops)
+            done = done | now_done | stalled
+            return cand_node, cand_l, queried, hops, done, round_no + 1
+        return body
+
     def cond(state):
         done, round_no = state[4], state[5]
         return (~jnp.all(done)) & (round_no < max_hops)
 
-    def body(state):
-        cand_node, cand_l, queried, hops, done, round_no = state
-        # select the closest α unqueried candidates per active search
-        # (↔ searchSendGetValues picking SearchNodes with canGet,
-        #  src/dht.cpp:628-639)
-        can = (cand_node >= 0) & (queried == 0) & ~done[:, None]
-        rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
-        sel = can & (rank <= alpha)
-        # gather selected rows into [Q, alpha] (−1 pad): α static masked
-        # max-reductions — a scatter-max here measured slower on TPU
-        x_rows = jnp.stack(
-            [jnp.max(jnp.where(sel & (rank == j + 1), cand_node, -1),
-                     axis=1) for j in range(alpha)], axis=1)
-
-        new_rows = reply_gather(x_rows, round_no + 1)
-        queried = jnp.where(sel, 1, queried)
-        cand_node, cand_l, queried = merge(
-            cand_node, cand_l, queried, new_rows)
-
-        now_done = synced(cand_node, queried)
-        stalled = ~jnp.any((cand_node >= 0) & (queried == 0), axis=1)
-        sent = jnp.any(sel, axis=1)
-        # a stalling round sends nothing → costs no hop (matches the
-        # scalar reference's stall return path)
-        hops = jnp.where(~done & sent, hops + 1, hops)
-        done = done | now_done | stalled
-        return cand_node, cand_l, queried, hops, done, round_no + 1
-
+    body_full = make_body(targets, pos_t_full, q_index)
     state = (cand_node, cand_l, queried,
              jnp.zeros((Q,), jnp.int32),
              synced(cand_node, queried) | empty,
              jnp.int32(0))
-    cand_node, cand_l, queried, hops, done, _ = \
-        lax.while_loop(cond, body, state)
+
+    if compact_after is None:
+        cand_node, cand_l, queried, hops, done, _ = \
+            lax.while_loop(cond, body_full, state)
+    else:
+        cut = min(compact_after, max_hops)
+
+        def cond1(st):
+            return (~jnp.all(st[4])) & (st[5] < cut)
+
+        cand_node, cand_l, queried, hops, done, rnd = \
+            lax.while_loop(cond1, body_full, state)
+
+        # pack survivors into a cap-wide sub-batch (fill duplicates of
+        # row 0 recompute identical values — harmless); run them to
+        # convergence at the narrow width, scatter back
+        C = compact_cap or max(1, Q // 2)
+        sel_rows = jnp.nonzero(~done, size=C, fill_value=0)[0]
+        live = jnp.take(~done, sel_rows)
+
+        def sub(a):
+            return jnp.take(a, sel_rows, axis=0)
+
+        sub_state = (sub(cand_node), [sub(cl) for cl in cand_l],
+                     sub(queried), sub(hops), ~live, rnd)
+        body_sub = make_body(sub(targets), sub(pos_t_full), sub(q_index))
+        cn2, cl2, qd2, hp2, dn2, rnd2 = \
+            lax.while_loop(cond, body_sub, sub_state)
+
+        lv = live[:, None]
+        cand_node = cand_node.at[sel_rows].set(
+            jnp.where(lv, cn2, sub(cand_node)))
+        cand_l = [cl.at[sel_rows].set(jnp.where(lv, c2, sub(cl)))
+                  for cl, c2 in zip(cand_l, cl2)]
+        queried = queried.at[sel_rows].set(jnp.where(lv, qd2, sub(queried)))
+        hops = hops.at[sel_rows].set(jnp.where(live, hp2, sub(hops)))
+        done = done.at[sel_rows].set(jnp.where(live, dn2, sub(done)))
+
+        # safety net: if more than C searches survived the cut, finish
+        # them at full width (ZERO iterations when the cap held).  The
+        # round counter RESTARTS AT THE CUT, not at the sub-loop's end:
+        # overflow rows were paused at round `rnd`, so resuming there
+        # replays exactly the reply streams the uncompacted engine
+        # would have given them (streams key on global query id +
+        # round) — bitwise identity holds even on overflow, and the
+        # sub-loop cannot starve overflow rows' round budget.  Rows
+        # the sub-loop already finished are done and untouched.  (The
+        # one residual divergence: a row still unconverged at max_hops
+        # after the sub-loop re-enters here and sees its last rounds'
+        # streams again — it can only stall/dedup on them, and such
+        # rows are reported converged=False either way.)
+        cand_node, cand_l, queried, hops, done, _ = lax.while_loop(
+            cond, body_full,
+            (cand_node, cand_l, queried, hops, done, rnd))
 
     nodes_k = cand_node[:, :k]
     if NL == N_LIMBS:
@@ -403,12 +476,14 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "alpha", "search_nodes", "max_hops",
-                     "state_limbs"),
+                     "state_limbs", "compact_after", "compact_cap"),
 )
 def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
                      k: int = TARGET_NODES, alpha: int = ALPHA,
                      search_nodes: int = SEARCH_NODES, max_hops: int = 48,
-                     lut=None, state_limbs: int = N_LIMBS):
+                     lut=None, state_limbs: int = N_LIMBS,
+                     compact_after: "int | None" = None,
+                     compact_cap: int = 0):
     """Run Q iterative lookups to convergence against an N-node network.
 
     Args:
@@ -467,7 +542,9 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     return _lookup_engine(gather_planar, lower, n, targets,
                           jnp.arange(Q, dtype=jnp.int32), Q, seed_u,
                           k=k, alpha=alpha, search_nodes=search_nodes,
-                          max_hops=max_hops, state_limbs=state_limbs)
+                          max_hops=max_hops, state_limbs=state_limbs,
+                          compact_after=compact_after,
+                          compact_cap=compact_cap)
 
 
 # ---------------------------------------------------------------------------
