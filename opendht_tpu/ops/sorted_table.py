@@ -431,10 +431,12 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     the top 64 distance bits (≈2^-47 per pair; detected by an
     adjacent-tie check over the first k+1 sorted rows and folded into
     ``certified``, so ties fall back like any uncertified query).
-    ``"fast2"`` = like fast3 but limbs 2-4 are not carried at all —
-    the sort moves 4 operands instead of 7 (measured 7.5 ms vs 14.8 ms
-    per 131K×192 batch on v5e; sort cost is linear in operand count)
-    and ``dist`` comes back as ``None``.  The certificate then uses a
+    ``"fast2"`` = like fast3 but limbs 2-4 are not carried at all and
+    the invalid flag is folded into sentinel key values — the sort
+    moves 3 operands instead of 7 (sort cost is linear in operand
+    count; measured 7.5 ms for the 4-operand form vs 14.8 ms for 7 per
+    131K×192 batch on v5e) and ``dist`` comes back as ``None``.  The
+    certificate then uses a
     *lower bound* on the kth result's common prefix (exact below 64
     bits, clamped at 64 above — conservative, so borderline queries
     decertify rather than mis-certify).  Use it when the caller needs
@@ -495,8 +497,35 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
                      for l in range(N_LIMBS)]
         top_idx = jnp.where(valid_k, gidx, -1)
         top_dist = jnp.stack(top_limbs, axis=-1)           # single 3-D build
+    elif select == "fast2":
+        # 3-OPERAND sort: the invalid flag is folded into sentinel
+        # values — invalid lanes get (d0, d1, gr) = (~0, ~0, GR_SENT),
+        # which sorts after every valid candidate (a genuine candidate
+        # with an all-ones top-64 distance still wins the gr tiebreak,
+        # and its cp_k lower bound is 0, so the certificate can never
+        # certify that query — the ambiguity is unreachable in
+        # certified output).  Sort cost is linear in operand count:
+        # 4 → 3 operands is 25% off the headline kernel's largest term.
+        big = jnp.uint32(0xFFFFFFFF)
+        gr = start[:, None] + jnp.arange(wlen, dtype=jnp.int32)[None, :]
+        inv_m = gr >= n_valid
+        gr_sent = jnp.int32(0x7FFFFFFF)
+        d0 = jnp.where(inv_m, big, plane[0][:, 1:erow - 1]
+                       ^ queries[:, 0:1])
+        d1 = jnp.where(inv_m, big, plane[1][:, 1:erow - 1]
+                       ^ queries[:, 1:2])
+        grm = jnp.where(inv_m, gr_sent, gr)
+        out = lax.sort((d0, d1, grm), dimension=1, num_keys=3)
+        valid_k = out[2][:, :k] != gr_sent
+        top_limbs = [jnp.where(valid_k, out[l][:, :k], big)
+                     for l in range(2)]
+        top_idx = jnp.where(valid_k, out[2][:, :k], -1)
+        top_dist = None
+        # tie-check operands (same layout as the keyed form below)
+        tie_a0, tie_a1 = out[0][:, :k + 1], out[1][:, :k + 1]
+        tie_av = out[2][:, :k + 1] != gr_sent
     else:
-        nd = 2 if select == "fast2" else N_LIMBS
+        nd = N_LIMBS
         d = [plane[l][:, 1:erow - 1] ^ queries[:, l:l + 1]
              for l in range(nd)]                           # nd × [Q, 3s]
         gr = start[:, None] + jnp.arange(wlen, dtype=jnp.int32)[None, :]
@@ -511,8 +540,9 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
                                jnp.uint32(0xFFFFFFFF))
                      for l in range(nd)]
         top_idx = jnp.where(valid_k, out[1 + nd][:, :k], -1)
-        top_dist = (jnp.stack(top_limbs, axis=-1)          # single 3-D build
-                    if nd == N_LIMBS else None)
+        top_dist = jnp.stack(top_limbs, axis=-1)           # single 3-D build
+        tie_a0, tie_a1 = out[1][:, :k + 1], out[2][:, :k + 1]
+        tie_av = out[0][:, :k + 1] == 0
 
     # window certificate (same argument as window_topk, start = 64j);
     # neighbor rows came along in the gathered row — no extra gather.
@@ -534,11 +564,9 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
         # first k+1 valid sorted rows (a tie anywhere in the sorted
         # order is an adjacent tie; ties past position k cannot change
         # the top-k set or its order).
-        a0 = out[1][:, :k + 1]
-        a1 = out[2][:, :k + 1]
-        av = out[0][:, :k + 1] == 0
-        tie = jnp.any((a0[:, 1:] == a0[:, :-1]) & (a1[:, 1:] == a1[:, :-1])
-                      & av[:, 1:] & av[:, :-1], axis=1)
+        tie = jnp.any((tie_a0[:, 1:] == tie_a0[:, :-1])
+                      & (tie_a1[:, 1:] == tie_a1[:, :-1])
+                      & tie_av[:, 1:] & tie_av[:, :-1], axis=1)
         certified = certified & ~tie
     return top_dist, top_idx, certified
 
