@@ -8,7 +8,9 @@ share the first b bits with self and differ at bit b.  This module
 vectorizes that partition and the maintenance sweeps built on it:
 
 - ``bucket_of``       peer → bucket index (= clipped commonBits with self)
-- ``bucket_counts``   per-bucket occupancy via one segment-sum
+- ``bucket_counts``   per-bucket occupancy via a fused [160, N]
+                      compare-and-reduce (segment scatters are
+                      serialization-bound on TPU — see its docstring)
 - ``bucket_last_seen``per-bucket max last-reply time (device-side variant
   of the staleness sweep; NodeTable.stale_buckets uses a host-side numpy
   reduction with never-replied semantics,
@@ -43,20 +45,32 @@ def bucket_of(self_id, ids):
 
 @jax.jit
 def bucket_counts(self_id, ids, valid):
-    """Occupancy of each of the 160 buckets.  int32 [160]."""
+    """Occupancy of each of the 160 buckets.  int32 [160].
+
+    Computed as a [160, N] compare-and-reduce rather than a
+    ``segment_sum``: scatter-adds are serialization-bound on TPU
+    (measured 97 ms for 10M unsorted indices vs ~2 ms for this form —
+    the compare fuses into the row reduction, and the [160, N]
+    orientation keeps the minor dimension unpadded).
+    """
     b = bucket_of(self_id, ids)
-    return jax.ops.segment_sum(
-        valid.astype(jnp.int32), b, num_segments=ID_BITS, indices_are_sorted=False
-    )
+    bm = jnp.where(valid, b, -1)
+    probes = jnp.arange(ID_BITS, dtype=jnp.int32)[:, None]
+    return jnp.sum(bm[None, :] == probes, axis=1).astype(jnp.int32)
 
 
 @jax.jit
 def bucket_last_seen(self_id, ids, valid, last_seen):
     """Per-bucket max of `last_seen` (float32/float64 [N]) over valid rows.
-    Buckets with no valid node get -inf.  [160]."""
+    Buckets with no valid node get -inf.  [160].
+
+    Same compare-and-reduce form as :func:`bucket_counts` (a
+    ``segment_max`` scatter measured ~45x slower at 10M rows)."""
     b = bucket_of(self_id, ids)
     vals = jnp.where(valid, last_seen, -jnp.inf)
-    return jax.ops.segment_max(vals, b, num_segments=ID_BITS)
+    probes = jnp.arange(ID_BITS, dtype=jnp.int32)[:, None]
+    masked = jnp.where(b[None, :] == probes, vals[None, :], -jnp.inf)
+    return jnp.max(masked, axis=1)
 
 
 # host-precomputed prefix masks: row b = mask of the first b bits
