@@ -398,8 +398,9 @@ def test_expanded_fast2_idx_exact():
 def test_expanded_topk_parametric_stride(stride):
     """expand_table generalizes over stride (window = 3·stride): every
     stride must stay exact on certified rows and the certificate must
-    stay sound.  stride=42 (126-window — pads to exactly 128 sort lanes)
-    is the headline-bench geometry (bench.py HEADLINE_STRIDE)."""
+    stay sound.  stride=32 (96-window — sorts in 128 padded lanes) is
+    the headline-bench geometry (bench.py HEADLINE_STRIDE); 42 and 64
+    are swept variants (42 was the round-2 headline)."""
     from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
                                               expanded_topk)
     from opendht_tpu.ops.xor_topk import xor_topk
@@ -432,10 +433,10 @@ def test_expanded_topk_parametric_stride(stride):
 
 
 def test_cascade_topk_two_stage_device_repair():
-    """cascade_topk: stage-1 (stride-42, LUT-only positioning) misses are
-    repaired on device by the wide stride-64 rescan; residual
-    uncertified rows (cap overflow / adversarial) stay flagged and the
-    host fallback path remains exact."""
+    """cascade_topk: stage-1 (stride-42 here; the headline bench uses
+    stride 32) misses are repaired on device by the wide stride-64
+    rescan; residual uncertified rows (cap overflow / adversarial) stay
+    flagged and the host fallback path remains exact."""
     from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
                                               cascade_topk)
     from opendht_tpu.ops.xor_topk import xor_topk
