@@ -407,7 +407,220 @@ def config2() -> dict:
     return out
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+def config6(churn: int = 0) -> dict:
+    """Sustained churn: mutations absorbed WHILE lookups run (SURVEY §7
+    "incremental updates" — the round-3 verdict's top ask; reference
+    mutation path src/routing_table.cpp:204-262).
+
+    One timed *round* = one device call that (a) absorbs E evictions as
+    tombstone-word writes, (b) appends E inserts to the delta slab,
+    (c) re-sorts + re-expands the delta, and (d) answers a Q-query
+    lookup wave through the churn kernel (tombstone-masked base window
+    + delta window + 2k merge; ops/sorted_table.churn_lookup_topk) —
+    chain-slope timed like every device number here.  The tombstone
+    writes are whole-word ``set`` scatters (values precomputed on the
+    host), so reps of the chain are idempotent — required for the
+    slope methodology.
+
+    Sustained throughput composes measured parts:
+      Q / (round_dt + host_prep_dt + compact_dt / rounds_per_compaction)
+    where compaction (re-sort + re-expand + re-LUT of base ∪ delta,
+    all on device) runs every delta_cap/E rounds, and host_prep is the
+    numpy mutation bookkeeping (host wall-clock — trustworthy for host
+    work).  The static comparator is the same-shape plain lookup
+    (expanded_topk, no churn structures); the verdict bar is churny
+    within ~20% of static at reference-realistic churn (a node table
+    fully turning over on the ~10-minute NODE_EXPIRE_TIME scale,
+    node.h:151 — ≈ N/600 mutations/s, which the default E meets at the
+    measured round rate).
+
+    Exactness: at the advanced churn state, a sampled query batch must
+    match the brute-force oracle over (live base ∪ delta) — the full
+    re-sort semantics — bit-for-bit (node set, order, distances).
+    """
+    import jax
+    import jax.numpy as jnp
+    from bench import chain_slope, best_of
+    from opendht_tpu.ops.sorted_table import (
+        sort_table, build_prefix_lut, default_lut_bits, expand_table,
+        churn_lookup_topk, expanded_topk, unpack_tomb_bits)
+    from opendht_tpu.ops.xor_topk import xor_topk
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = 10_000_000 if on_accel else 200_000
+    Q = 131_072 if on_accel else 8_192
+    DCAP = 262_144 if on_accel else 8_192
+    E = churn or (256 if on_accel else 64)      # evictions AND inserts/round
+    K = 8
+    lut_bits = default_lut_bits(N)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
+    del table
+    expanded = jax.block_until_ready(expand_table(sorted_ids))
+    lut = jax.block_until_ready(
+        build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
+    nv = int(jax.device_get(n_valid))
+
+    # ---- host churn state (mirrors ChurnView bookkeeping, vectorized)
+    rng = np.random.default_rng(70)
+    nwords = (N + 31) // 32
+    tomb_np = np.zeros(nwords, np.uint32)
+    live_np = np.zeros(N, bool)
+    live_np[:nv] = True
+    delta_np = np.zeros((DCAP, 5), np.uint32)
+    n_delta = 0
+
+    def prep_round():
+        """Pick E fresh live positions + E new ids; returns the device
+        args for one round and applies them to the host mirror."""
+        nonlocal n_delta
+        # exactly E DISTINCT live positions: dedupe within the batch and
+        # across retry iterations (live_np is only written below, so a
+        # duplicate draw would otherwise pass the liveness filter and
+        # the round would evict fewer rows than it inserts)
+        picks: list = []
+        seen: set = set()
+        while len(picks) < E:
+            for c in rng.integers(0, nv, size=2 * E):
+                c = int(c)
+                if live_np[c] and c not in seen:
+                    seen.add(c)
+                    picks.append(c)
+                    if len(picks) == E:
+                        break
+        pos = np.array(picks, dtype=np.int64)
+        live_np[pos] = False
+        w = np.unique(pos >> 5)
+        np.bitwise_or.at(tomb_np, pos >> 5,
+                         np.uint32(1) << (pos & 31).astype(np.uint32))
+        new_ids = rng.integers(0, 2**32, size=(E, 5), dtype=np.uint32)
+        nd0 = n_delta
+        delta_np[nd0:nd0 + E] = new_ids
+        n_delta = nd0 + E
+        widx = np.zeros(E, np.int64)            # pad to fixed length E
+        widx[:len(w)] = w
+        widx[len(w):] = w[-1] if len(w) else 0
+        return (jnp.asarray(widx), jnp.asarray(tomb_np[widx]),
+                jnp.asarray(new_ids), nd0)
+
+    # advance to a representative mid-cycle state (half the compaction
+    # cycle) so the timed round sees realistic tombstone/delta volume
+    warm_rounds = max(4, (DCAP // E) // 2) if on_accel else 8
+    t0 = __import__("time").perf_counter()
+    for _ in range(warm_rounds - 1):
+        prep_round()
+    host_prep_dt = (__import__("time").perf_counter() - t0) / (warm_rounds - 1)
+    widx, wval, new_ids, nd0 = prep_round()
+    # the scatter/update values are the post-round state, so chain reps
+    # are idempotent (required by the slope methodology) while the
+    # scatter + slice-update ops still execute at full cost every rep
+    tomb_base = jnp.asarray(tomb_np)
+    dslab = jnp.asarray(delta_np)
+    nd_after = jnp.int32(n_delta)
+
+    d_bits = default_lut_bits(DCAP)
+
+    def round_body(q, sorted_ids, expanded, lut, n_valid, tomb_base,
+                   widx, wval, dslab, new_ids, nd_after):
+        tomb = tomb_base.at[widx].set(wval)
+        ds_slab = jax.lax.dynamic_update_slice(
+            dslab, new_ids, (jnp.int32(nd0), 0))
+        dvalid = jnp.arange(DCAP) < nd_after
+        ds, _dp, dnv = sort_table(ds_slab, dvalid)
+        de = expand_table(ds, stride=32)
+        dlut = build_prefix_lut(ds, dnv, bits=d_bits)
+        # LUT-only positioning on BOTH sides (the sequential probe-gather
+        # steps dominate otherwise); fast2 = nodes-not-distances contract
+        _dist, enc, cert = churn_lookup_topk(
+            sorted_ids, expanded, n_valid, tomb, ds, de, dnv, q,
+            lut=lut, d_lut=dlut, k=K, select="fast2",
+            lut_steps=0, d_lut_steps=0)
+        return (jnp.sum(cert.astype(jnp.float32))
+                + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9)
+
+    r1, r2 = (2, 8) if on_accel else (1, 3)
+    round_dt = chain_slope(round_body, queries, sorted_ids, expanded, lut,
+                           n_valid, tomb_base, widx, wval, dslab, new_ids,
+                           nd_after, r1=r1, r2=r2)
+
+    # ---- static comparator: same-shape plain lookup, no churn structures
+    def static_body(q, sorted_ids, expanded, lut, n_valid):
+        d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
+                                  select="fast2", lut=lut, lut_steps=0)
+        return (jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
+
+    static_dt = chain_slope(static_body, queries, sorted_ids, expanded, lut,
+                            n_valid, r1=r1, r2=r2)
+
+    # ---- compaction: re-sort + re-expand + re-LUT of (live base ∪ delta)
+    # on device.  Wall-clock is trustworthy here because the result is
+    # forced back to the HOST (device_get of a dependent scalar cannot
+    # return before execution finishes) and the op is hundreds of ms —
+    # the completion-poll artifact that breaks micro-timing is noise.
+    tomb_dev = jnp.asarray(tomb_np)
+
+    @jax.jit
+    def compact(sorted_ids, dslab, tomb, n_valid, nd):
+        live = (jnp.arange(N) < n_valid) & ~unpack_tomb_bits(tomb, N)
+        cat = jnp.concatenate([sorted_ids, dslab], axis=0)
+        cval = jnp.concatenate([live, jnp.arange(DCAP) < nd])
+        s2, _p2, nv2 = sort_table(cat, cval)
+        e2 = expand_table(s2)
+        l2 = build_prefix_lut(s2, nv2, bits=lut_bits)
+        return (s2[0, 0].astype(jnp.float32) + e2[0, 0].astype(jnp.float32)
+                + l2[0].astype(jnp.float32) + nv2.astype(jnp.float32))
+
+    compact_dt = best_of(lambda: float(compact(
+        sorted_ids, dslab, tomb_dev, n_valid, nd_after)), tries=3)
+    rounds_per_compaction = max(1, DCAP // E)
+
+    # ---- exactness at the advanced state vs the full re-sort oracle:
+    # fast3 carries full distances (compared bit-for-bit) and the timed
+    # fast2 path must agree on the node encoding
+    qs = jax.random.bits(k3, (256, 5), dtype=jnp.uint32)
+    dvalid = np.zeros(DCAP, bool)
+    dvalid[:n_delta] = True
+    ds, _dp, dnv = sort_table(jnp.asarray(delta_np), jnp.asarray(dvalid))
+    de = expand_table(ds, stride=32)
+    dlut = build_prefix_lut(ds, dnv, bits=d_bits)
+    dist_c, enc_c, _ = churn_lookup_topk(
+        sorted_ids, expanded, n_valid, jnp.asarray(tomb_np), ds, de, dnv,
+        qs, lut=lut, d_lut=dlut, k=K, select="fast3")
+    _n, enc_f2, _ = churn_lookup_topk(
+        sorted_ids, expanded, n_valid, jnp.asarray(tomb_np), ds, de, dnv,
+        qs, lut=lut, d_lut=dlut, k=K, select="fast2",
+        lut_steps=0, d_lut_steps=0)
+    cat = jnp.concatenate([sorted_ids, ds], axis=0)
+    cval = jnp.concatenate([jnp.asarray(live_np),
+                            jnp.arange(DCAP) < dnv])
+    d_ref, i_ref = xor_topk(qs, cat, k=K, tile=4096, valid=cval)
+    exact = bool(np.array_equal(np.asarray(dist_c), np.asarray(d_ref))
+                 and np.array_equal(np.asarray(enc_c), np.asarray(enc_f2)))
+
+    denom = round_dt + host_prep_dt + compact_dt / rounds_per_compaction
+    churny = Q / denom
+    static = Q / static_dt
+    muts = 2 * E / denom
+    return {"metric": "config6 sustained churn, %d lookups/wave x %d-node "
+                      "table, %d+%d mutations/round absorbed on device "
+                      "(tombstone words + delta append+resort), delta cap "
+                      "%d, compaction every %d rounds (%.0f ms measured); "
+                      "churn-exact vs full-resort oracle: %s; static "
+                      "same-shape lookup %.0f lookups/s; churny/static "
+                      "%.3f; %.0f mutations/s sustained"
+                      % (Q, N, E, E, DCAP, rounds_per_compaction,
+                         compact_dt * 1e3, exact, static,
+                         churny / static, muts),
+            "value": round(churny, 1), "unit": "lookups/s/chip",
+            "vs_baseline": round(churny / static, 4)}
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
 
 
 def main(argv=None) -> int:
@@ -428,6 +641,8 @@ def main(argv=None) -> int:
     p.add_argument("--limbs", type=int, default=0,
                    help="config3: distance limbs carried through the "
                         "merge sorts (2 = fast default, 5 = exact-order)")
+    p.add_argument("--churn", type=int, default=0,
+                   help="config6: evictions (= inserts) per round")
     args = p.parse_args(argv)
     todo = [args.config] if args.config else sorted(CONFIGS)
     for c in todo:
@@ -435,9 +650,12 @@ def main(argv=None) -> int:
             print(json.dumps(config3_tp(Q=args.Q, N=args.N,
                                         limbs=args.limbs)))
             continue
-        kw = ({"Q": args.Q, "N": args.N, "chunk": args.chunk,
-               "limbs": args.limbs}
-              if c == 3 else {})
+        kw = {}
+        if c == 3:
+            kw = {"Q": args.Q, "N": args.N, "chunk": args.chunk,
+                  "limbs": args.limbs}
+        elif c == 6:
+            kw = {"churn": args.churn}
         print(json.dumps(CONFIGS[c](**kw)))
     return 0
 

@@ -46,7 +46,7 @@ spec = importlib.util.spec_from_file_location(
     "baseline_configs", pathlib.Path("benchmarks/baseline_configs.py"))
 m = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(m)
-for c in (1, 3, 4, 5):
+for c in (1, 3, 4, 5, 6):
     m.main(["-c", str(c)])
 PY
 # table-sharded iterative mode on a REAL 8-device virtual mesh.  The

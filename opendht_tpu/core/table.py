@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from ..infohash import InfoHash
 from ..ops import ids as IK
 from ..ops import radix
-from ..ops.sorted_table import sort_table, lookup_topk, expand_table
+from ..ops.sorted_table import (sort_table, lookup_topk, expand_table,
+                                churn_lookup_topk)
 
 # liveness windows (reference include/opendht/node.h:148-158)
 NODE_GOOD_TIME = 120 * 60.0       # replied within 2 h → good
@@ -46,6 +47,20 @@ MAX_AUTH_ERRORS = 3               # 3 strikes → expired (node.h:73-77)
 
 TARGET_NODES = 8                  # k (routing_table.h:26)
 SEARCH_NODES = 14                 # search candidate set (dht.h:308)
+
+DELTA_CAP = 4096                  # churn side-slab capacity (inserts
+                                  # absorbed without re-sorting)
+TOMB_MIN = 1024                   # compact when tombstones exceed
+TOMB_FRAC = 16                    # max(TOMB_MIN, n_base // TOMB_FRAC)
+
+# Below these sizes closest-node queries run as an exact numpy scan on
+# the host slab instead of a device kernel: a live protocol node's
+# table is tens-to-hundreds of rows, where one XLA compile (~10 s on a
+# CPU backend) or even one device round-trip dwarfs the O(Q·N) scan.
+# The device path (snapshot/churn kernels) is for simulation-scale
+# tables and query waves, where it is the headline win.
+HOST_SCAN_MAX_ROWS = 4096
+HOST_SCAN_MAX_QUERIES = 64
 
 
 @dataclasses.dataclass
@@ -99,16 +114,149 @@ class Snapshot:
         return rows.astype(np.int32), np.asarray(dist)
 
 
+class ChurnView:
+    """Append+tombstone view over an immutable base :class:`Snapshot`
+    (SURVEY §7 "incremental updates"; reference mutation path
+    src/routing_table.cpp:204-262).
+
+    Mutations since the base was built are absorbed host-side in O(1):
+    evictions set one bit in a packed tombstone mask over *sorted
+    positions* (dead rows stay in the device array as mere sort keys);
+    inserts land in a small delta slab.  ``lookup`` runs
+    ops/sorted_table.churn_lookup_topk — tombstone-masked window top-k
+    over the base, window top-k over the delta (kept as its own mini
+    sorted+expanded table, re-sorted lazily per mutation batch), one
+    2k-wide merge — in a single device call, bit-identical to a full
+    re-sort of the mutated id set.  Device state is refreshed lazily:
+    tombstone words re-upload whole (1.25 MB per 10M rows — noise), the
+    delta re-sorts on device (one small sort+expand per dirty batch).
+
+    Correctness never depends on churn volume (a heavily-tombstoned
+    window decertifies into the kernel's exact fallback), so compaction
+    — dropping this view and rebuilding the base — is purely a
+    performance policy, owned by :class:`NodeTable`.
+    """
+
+    def __init__(self, base: Snapshot, cap_rows: int,
+                 delta_cap: int = DELTA_CAP):
+        self.base = base
+        n = base.sorted_ids.shape[0]
+        perm = np.asarray(base.perm)
+        self.n_base = int((perm >= 0).sum())
+        self._perm = perm
+        # slab row -> sorted position AT BASE-BUILD TIME.  Never re-read
+        # after the row is freed+reused: inserts always go to the delta,
+        # and note_evict checks delta membership first, so a stale
+        # mapping is only ever used to tombstone the id that actually
+        # occupied the position.
+        self.inv_perm = np.full(cap_rows, -1, dtype=np.int64)
+        pos = np.nonzero(perm >= 0)[0]
+        self.inv_perm[perm[pos]] = pos
+        self.tomb_np = np.zeros((n + 31) // 32, dtype=np.uint32)
+        self.tomb_count = 0
+        self.delta_ids_np = np.zeros((delta_cap, IK.N_LIMBS), dtype=np.uint32)
+        self.delta_rows = np.full(delta_cap, -1, dtype=np.int64)
+        self._delta_pos: dict[int, int] = {}
+        self.n_delta = 0
+        self._dev_tomb = None
+        self._dev_delta = None            # (d_sorted, d_expanded, d_n_valid)
+        self._d_perm = None               # delta sorted pos -> slot
+        self._dirty_tomb = True
+        self._dirty_delta = True
+
+    @property
+    def pending(self) -> int:
+        return self.tomb_count + self.n_delta
+
+    def note_insert(self, row: int, limbs) -> bool:
+        """Absorb a newly-live slab row.  False = delta slab full (the
+        caller must compact).  The row must NOT be live in the base:
+        NodeTable only routes here rows that are new, revived after an
+        expiry (whose base position the expiry tombstoned), or absent
+        from the base mask at build time — so live ids stay unique
+        across base and delta and merge order stays exact."""
+        if row in self._delta_pos:
+            return True
+        if self.n_delta >= self.delta_ids_np.shape[0]:
+            return False
+        s = self.n_delta
+        self.delta_ids_np[s] = limbs
+        self.delta_rows[s] = row
+        self._delta_pos[row] = s
+        self.n_delta = s + 1
+        self._dirty_delta = True
+        return True
+
+    def note_evict(self, row: int) -> None:
+        """Absorb a row leaving the live set (evicted or expired).
+        Delta membership is checked before the base mapping so a reused
+        slab row never tombstones another id's position."""
+        s = self._delta_pos.pop(row, None)
+        if s is not None:
+            last = self.n_delta - 1
+            if s != last:
+                self.delta_ids_np[s] = self.delta_ids_np[last]
+                lrow = int(self.delta_rows[last])
+                self.delta_rows[s] = lrow
+                self._delta_pos[lrow] = s
+            self.delta_rows[last] = -1
+            self.n_delta = last
+            self._dirty_delta = True
+            return
+        if 0 <= row < len(self.inv_perm):
+            p = int(self.inv_perm[row])
+            if p >= 0 and not (int(self.tomb_np[p >> 5]) >> (p & 31)) & 1:
+                self.tomb_np[p >> 5] |= np.uint32(1) << (p & 31)
+                self.tomb_count += 1
+                self._dirty_tomb = True
+
+    def lookup(self, queries, *, k: int = TARGET_NODES, window: int = 128):
+        """Batched exact k-closest over (live base ∪ delta) — same
+        contract as :meth:`Snapshot.lookup` (``window`` ignored)."""
+        q = jnp.asarray(queries, jnp.uint32)
+        base = self.base
+        if base._expanded is None:
+            base._expanded = expand_table(base.sorted_ids)
+        if self._dirty_tomb or self._dev_tomb is None:
+            self._dev_tomb = jnp.asarray(self.tomb_np)
+            self._dirty_tomb = False
+        if self._dirty_delta or self._dev_delta is None:
+            dcap = self.delta_ids_np.shape[0]
+            dvalid = np.zeros(dcap, bool)
+            dvalid[:self.n_delta] = True      # slots are prefix-dense
+            ds, dp, dnv = sort_table(jnp.asarray(self.delta_ids_np),
+                                     jnp.asarray(dvalid))
+            self._dev_delta = (ds, expand_table(ds, stride=32), dnv)
+            self._d_perm = np.asarray(dp)
+            self._dirty_delta = False
+        ds, de, dnv = self._dev_delta
+        dist, enc, _ = churn_lookup_topk(
+            base.sorted_ids, base._expanded, base.n_valid,
+            self._dev_tomb, ds, de, dnv, q, k=k)
+        enc = np.asarray(enc)
+        n = base.sorted_ids.shape[0]
+        # enc in [n, n+D) is a *delta sorted position* → slot → slab row
+        dslot = self._d_perm[np.clip(enc - n, 0, len(self._d_perm) - 1)]
+        rows = np.where(
+            enc < 0, -1,
+            np.where(enc < n, self._perm[np.clip(enc, 0, n - 1)],
+                     self.delta_rows[np.clip(dslot, 0, None)]))
+        return rows.astype(np.int32), np.asarray(dist)
+
+
 class NodeTable:
     """Growable peer slab with k-bucket admission (one per address family,
     like the reference's buckets4/buckets6, dht.h:370-381)."""
 
     def __init__(self, self_id: InfoHash, *, k: int = TARGET_NODES,
-                 capacity: int = 1024):
+                 capacity: int = 1024, delta_cap: int = DELTA_CAP):
         self.self_id = self_id
         self.self_limbs = IK.ids_from_bytes(bytes(self_id)).reshape(-1)
         self.k = k
         self._cap = capacity
+        self._delta_cap = delta_cap
+        self._churn: Optional[ChurnView] = None
+        self.compactions = 0              # full re-sort+re-expand count
         self._ids = np.zeros((capacity, IK.N_LIMBS), dtype=np.uint32)
         self._valid = np.zeros(capacity, dtype=bool)
         self._expired = np.zeros(capacity, dtype=bool)
@@ -166,8 +314,36 @@ class NodeTable:
 
     # ------------------------------------------------------------- mutation
     def _touch(self) -> None:
+        """Structural change the churn view cannot absorb: drop both the
+        base snapshot and the churn state (next view rebuilds)."""
         self._version += 1
         self._snap = None
+        self._churn = None
+
+    def _tomb_limit(self) -> int:
+        ch = self._churn
+        n = ch.n_base if ch is not None else 0
+        return max(TOMB_MIN, n // TOMB_FRAC)
+
+    def _absorb_insert(self, row: int) -> None:
+        """A slab row became live.  Absorbed into the churn delta when a
+        'reachable' base view is active (``_version`` untouched — the
+        change is *in* the view); otherwise full invalidation."""
+        ch = self._churn
+        if ch is not None and self._snap is not None:
+            if ch.note_insert(row, self._ids[row]):
+                return
+        self._touch()                   # delta full or no churn view
+
+    def _absorb_evict(self, row: int) -> None:
+        """A slab row left the live set (evicted or expired)."""
+        ch = self._churn
+        if ch is not None and self._snap is not None:
+            ch.note_evict(row)
+            if ch.tomb_count > self._tomb_limit():
+                self._touch()           # compaction due (perf policy)
+            return
+        self._touch()
 
     def insert(self, node_id: InfoHash, addr: Any, now: Optional[float] = None,
                *, confirm: int = 0) -> Optional[int]:
@@ -188,13 +364,20 @@ class NodeTable:
         if row is not None:
             self._time_seen[row] = now
             if confirm >= 2:
-                # liveness transitions (revival, first reply) must invalidate
-                # cached snapshots; routine reply refreshes need not — the
-                # good-mask snapshot is already time-bucketed
-                if self._expired[row] or self._time_reply[row] == 0:
-                    self._touch()
+                if self._expired[row]:
+                    # revival: the row is dead in every view (its base
+                    # copy, if any, was tombstoned when it expired) —
+                    # re-enters as a delta insert
+                    self._expired[row] = False
+                    self._absorb_insert(row)
+                elif self._time_reply[row] == 0:
+                    # first reply: 'reachable' membership is unchanged
+                    # (the row was already in that view), but a cached
+                    # 'good'-mask snapshot goes stale
+                    if self._snap is not None \
+                            and self._snap.mask_key[0] == "good":
+                        self._touch()
                 self._time_reply[row] = now
-                self._expired[row] = False
                 self._auth_err[row] = 0
             if addr is not None:
                 self._addrs[row] = addr
@@ -223,7 +406,7 @@ class NodeTable:
         self._addrs[row] = addr
         self._row_of[key] = row
         self._bucket_count[b] += 1
-        self._touch()
+        self._absorb_insert(row)
         return row
 
     def _evict_row(self, row: int) -> None:
@@ -234,7 +417,7 @@ class NodeTable:
         self._valid[row] = False
         self._addrs[row] = None
         self._free.append(row)
-        self._touch()
+        self._absorb_evict(row)
 
     def remove(self, node_id: InfoHash) -> None:
         row = self._row_of.get(bytes(node_id))
@@ -254,18 +437,19 @@ class NodeTable:
         """Request to the peer timed out 3× (↔ Node::setExpired via
         NetworkEngine timeouts, src/request.h:108-112)."""
         row = self._row_of.get(bytes(node_id))
-        if row is not None:
+        if row is not None and not self._expired[row]:
             self._expired[row] = True
-            self._touch()
+            self._absorb_evict(row)
 
     def on_auth_error(self, node_id: InfoHash) -> None:
         """Crypto failure from this peer; 3 strikes expire it (node.h:73-77)."""
         row = self._row_of.get(bytes(node_id))
         if row is not None:
             self._auth_err[row] += 1
-            if self._auth_err[row] >= MAX_AUTH_ERRORS:
+            if self._auth_err[row] >= MAX_AUTH_ERRORS \
+                    and not self._expired[row]:
                 self._expired[row] = True
-            self._touch()
+                self._absorb_evict(row)
 
     def clear_bad(self) -> None:
         """Drop expired nodes (↔ NodeCache::clearBadNodes on connectivity
@@ -294,7 +478,13 @@ class NodeTable:
         raw = IK.ids_to_bytes(ids_u32)
         for i, row in enumerate(rows):
             self._row_of[raw[i].tobytes()] = int(row)
-        self._touch()
+        ch = self._churn
+        if ch is not None and self._snap is not None \
+                and ch.n_delta + n <= self.delta_capacity:
+            for i, row in enumerate(rows):
+                ch.note_insert(int(row), ids_u32[i])
+        else:
+            self._touch()
 
     # --------------------------------------------------------------- reads
     def get_view(self, row: int) -> NodeView:
@@ -316,17 +506,31 @@ class NodeTable:
     def id_of(self, row: int) -> InfoHash:
         return InfoHash(IK.ids_to_bytes(self._ids[row]).tobytes())
 
+    @property
+    def delta_capacity(self) -> int:
+        return self._delta_cap
+
+    @property
+    def churn_pending(self) -> int:
+        """Mutations absorbed by the churn view since the last base
+        build (tombstones + delta inserts).  0 ⇒ the base snapshot is
+        complete."""
+        return self._churn.pending if self._churn is not None else 0
+
     def snapshot(self, now: Optional[float] = None, *,
                  mask: str = "reachable") -> Snapshot:
-        """Device snapshot for batched queries.  mask: 'reachable' (valid &
-        not expired), 'good', or 'valid'.  Cached until the table mutates
-        (liveness masks additionally keyed by a 10 s time bucket)."""
+        """Full device snapshot for batched queries.  mask: 'reachable'
+        (valid & not expired), 'good', or 'valid'.  Cached until the
+        table mutates (liveness masks additionally keyed by a 10 s time
+        bucket).  Pending churn (delta inserts / tombstones) forces a
+        rebuild here — this is the compaction point; lookups that can
+        use the incremental view go through :meth:`view` instead."""
         if now is None:
             now = time.monotonic()
         tkey = int(now // 10) if mask == "good" else 0
         mk = (mask, tkey)
         if self._snap is not None and self._snap.version == self._version \
-                and self._snap.mask_key == mk:
+                and self._snap.mask_key == mk and self.churn_pending == 0:
             return self._snap
         if mask == "good":
             m = self.good_mask(now)
@@ -338,7 +542,25 @@ class NodeTable:
             jnp.asarray(self._ids), jnp.asarray(m)
         )
         self._snap = Snapshot(sorted_ids, perm, n_valid, self._version, mk)
+        # churn absorption only tracks the 'reachable' mask — the one
+        # every routing lookup uses.  'good'/'valid' snapshots rebuild
+        # on mutation as before.
+        self._churn = ChurnView(self._snap, self._cap, self._delta_cap) \
+            if mask == "reachable" else None
+        self.compactions += 1
         return self._snap
+
+    def view(self, now: Optional[float] = None, *, mask: str = "reachable"):
+        """Lookup view: the O(1)-mutation churn view while deltas or
+        tombstones are pending, else the plain snapshot.  Both expose
+        ``lookup(queries, k=, window=)`` with identical (exact)
+        results; the churn view skips the full re-sort + re-expand a
+        mutation would otherwise cost (SURVEY §7 incremental updates)."""
+        ch = self._churn
+        if ch is not None and self._snap is not None and ch.pending \
+                and self._snap.mask_key == (mask, 0):
+            return ch
+        return self.snapshot(now, mask=mask)
 
     def find_closest(self, targets, *, k: int = TARGET_NODES,
                      now: Optional[float] = None, mask: str = "reachable",
@@ -349,10 +571,47 @@ class NodeTable:
 
         targets: [Q,5] uint32, [Q,20] uint8, bytes, or list of InfoHash.
         Returns (rows [Q,k] int32, dist [Q,k,5] uint32) numpy, -1 padded.
+
+        Small tables × small batches (the live protocol regime) take an
+        exact host scan over the slab — no snapshot, no device call, no
+        compile; results are bit-identical to the device path (live ids
+        are unique, so XOR distances never tie and the order is fully
+        determined).  Large tables or big query waves go through
+        :meth:`view` (device snapshot / churn kernels).
         """
         q = _as_limbs(targets)
-        snap = self.snapshot(now, mask=mask)
-        return snap.lookup(q, k=k, window=window)
+        q = q.reshape(-1, IK.N_LIMBS)
+        if len(self) <= HOST_SCAN_MAX_ROWS \
+                and q.shape[0] <= HOST_SCAN_MAX_QUERIES:
+            return self._find_closest_host(q, k, now, mask)
+        return self.view(now, mask=mask).lookup(q, k=k, window=window)
+
+    def _find_closest_host(self, q: np.ndarray, k: int,
+                           now: Optional[float], mask: str):
+        """Exact numpy top-k over the live slab rows (host fast path)."""
+        if now is None:
+            now = time.monotonic()
+        if mask == "good":
+            m = self.good_mask(now)
+        elif mask == "valid":
+            m = self._valid
+        else:
+            m = self.reachable_mask(now)
+        rows = np.nonzero(m)[0]
+        Qn = q.shape[0]
+        out_rows = np.full((Qn, k), -1, dtype=np.int32)
+        out_dist = np.full((Qn, k, IK.N_LIMBS), 0xFFFFFFFF, dtype=np.uint32)
+        if len(rows):
+            d = self._ids[rows][None, :, :] ^ q[:, None, :]    # [Q, n, 5]
+            for i in range(Qn):
+                # lexicographic 160-bit ordering: np.lexsort's LAST key
+                # is primary (limb 0), matching InfoHash::xorCmp
+                order = np.lexsort(
+                    (d[i, :, 4], d[i, :, 3], d[i, :, 2],
+                     d[i, :, 1], d[i, :, 0]))[:k]
+                out_rows[i, :len(order)] = rows[order]
+                out_dist[i, :len(order)] = d[i, order]
+        return out_rows, out_dist
 
     # --------------------------------------------------------- maintenance
     def bucket_occupancy(self) -> np.ndarray:

@@ -712,7 +712,16 @@ class NetworkEngine:
             body["token"] = token
         if vids:
             body[key] = vids
-        self._send(self._header("u", body, "r", socket_id), node.addr)
+        # the u-channel packs 't' as a plain msgpack uint — the ONE
+        # departure from the bin4 TransId every other message uses
+        # (tellListenerRefreshed/Expired pack the Tid integer directly,
+        # network_engine.cpp:206,236; both sides' parsers accept both
+        # forms, parsed_message.h:29-36, but byte-compat means emitting
+        # what the reference emits)
+        out: dict = {"u": body, "t": int(socket_id), "y": "r", "v": AGENT}
+        if self.network:
+            out["n"] = self.network
+        self._send(pack_msg(out), node.addr)
 
     def tell_listener_refreshed(self, node: Node, socket_id: int,
                                 info_hash: InfoHash, token: bytes,

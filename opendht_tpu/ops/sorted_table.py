@@ -116,11 +116,19 @@ def build_prefix_lut(sorted_ids, n_valid, *, bits: int = LUT_BITS):
     positioning mode inside the expanded window's margin).
     """
     N = sorted_ids.shape[0]
+    nb = 1 << bits
     keys = (sorted_ids[:, 0] >> jnp.uint32(32 - bits)).astype(jnp.int32)
     keys = jnp.where(jnp.arange(N) < jnp.asarray(n_valid, jnp.int32),
-                     keys, jnp.int32(1 << bits))
-    probes = jnp.arange((1 << bits) + 1, dtype=jnp.int32)
-    return jnp.searchsorted(keys, probes, side="left").astype(jnp.int32)
+                     keys, jnp.int32(nb))
+    # histogram + exclusive cumsum, NOT searchsorted: on sorted keys
+    # "first row with prefix >= p" is exactly sum(counts[< p]), and the
+    # scatter-add + scan build is one pass over N + one over 2^bits —
+    # measured ~8 ms faster per build at 2^18 probes on v5e, which is
+    # what makes the churn path's per-round delta LUT rebuild free
+    # (benchmarks/baseline_configs.py config6).
+    counts = jnp.zeros((nb + 1,), jnp.int32).at[keys].add(1)
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(counts[:nb], dtype=jnp.int32)])
 
 
 def _lut_bits(lut) -> int:
@@ -417,9 +425,21 @@ def expand_table_chunked(sorted_ids, *, stride: int = EXPAND_STRIDE,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps"))
+def unpack_tomb_bits(tomb_bits, n: int):
+    """Packed little-endian uint32 tombstone words → bool [n] mask.
+    Word w bit b covers sorted position 32·w + b (the packing
+    :func:`churn_lookup_topk` and core/table.py agree on)."""
+    nw = tomb_bits.shape[0]
+    words = jnp.repeat(tomb_bits, 32)[:n]
+    shifts = jnp.tile(jnp.arange(32, dtype=jnp.uint32), nw)[:n]
+    return ((words >> shifts) & 1) != 0
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps",
+                                             "fast2_limbs"))
 def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
-                  select: str = "auto", lut=None, lut_steps=None):
+                  select: str = "auto", lut=None, lut_steps=None,
+                  tomb_bits=None, fast2_limbs: bool = False):
     """k XOR-closest via the expanded table — one row gather per query.
 
     ``select``: ``"pallas"`` = fused min-extraction kernel
@@ -471,6 +491,38 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     j = jnp.clip((pos - stride) // stride, 0, jmax)
     start = j * stride
 
+    # Tombstones (churn path, core/table.py): a packed bitmask over
+    # *sorted positions* folds dead rows into the in-window invalid
+    # lanes, so evictions need no re-sort.  stride % 32 == 0 keeps the
+    # extraction gather-free: window starts land on word boundaries, so
+    # each query reads wlen/32 whole words (one tiny [Q, nw] gather) and
+    # the per-lane bit is static (lane L → word L//32, bit L%32 — a
+    # repeat/tile, not a gather).  The exactness certificate is
+    # unaffected: it bounds rows *outside* the window via the edge
+    # neighbors' sorted-order position, which liveness doesn't change,
+    # and dead in-window rows are merely unselectable.
+    tomb = None
+    if tomb_bits is not None:
+        if stride % 32:
+            raise ValueError(
+                f"tomb_bits requires stride % 32 == 0 (got {stride})")
+        Q = queries.shape[0]
+        sw = stride // 32
+        nw = wlen // 32                         # = 3·sw
+        # Block the word array into per-window ROWS (same shifted-slice
+        # trick as expand_table) so the per-query fetch is one row
+        # gather — a flat [Q·nw] element gather is issue-rate-bound and
+        # measured ~7 ms/131K-batch; the [NB, nw] build is one pass
+        # over the (tiny) word array, fused into the same program.
+        padw = (NB + 2) * sw - tomb_bits.shape[0]
+        Bw = jnp.pad(tomb_bits, (0, max(padw, 0)))[:(NB + 2) * sw] \
+            .reshape(NB + 2, sw)
+        tomb_rows = jnp.concatenate([Bw[:NB], Bw[1:NB + 1], Bw[2:NB + 2]],
+                                    axis=1)     # [NB, nw]
+        words = jnp.take(tomb_rows, j, axis=0)  # [Q, nw] row gather
+        shifts = jnp.tile(jnp.arange(32, dtype=jnp.uint32), nw)
+        tomb = ((jnp.repeat(words, 32, axis=1) >> shifts[None, :]) & 1) != 0
+
     rows = jnp.take(expanded, j, axis=0)                   # [Q, 5·(3s+2)]
     # limb planes — contiguous lane slices, everything stays 2-D
     plane = [rows[:, l * erow:(l + 1) * erow] for l in range(N_LIMBS)]
@@ -479,6 +531,9 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
 
     if select == "pallas":
         from .pallas_window_topk import window_select
+        if tomb is not None:
+            raise ValueError("tomb_bits is not supported by the pallas "
+                             "select (bounds-based masking only)")
         if erow != _EROW:
             raise ValueError("pallas window_select supports only the "
                              f"default stride {EXPAND_STRIDE}")
@@ -509,6 +564,8 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
         big = jnp.uint32(0xFFFFFFFF)
         gr = start[:, None] + jnp.arange(wlen, dtype=jnp.int32)[None, :]
         inv_m = gr >= n_valid
+        if tomb is not None:
+            inv_m = inv_m | tomb
         gr_sent = jnp.int32(0x7FFFFFFF)
         d0 = jnp.where(inv_m, big, plane[0][:, 1:erow - 1]
                        ^ queries[:, 0:1])
@@ -520,7 +577,10 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
         top_limbs = [jnp.where(valid_k, out[l][:, :k], big)
                      for l in range(2)]
         top_idx = jnp.where(valid_k, out[2][:, :k], -1)
-        top_dist = None
+        # fast2_limbs: hand the sorted top-64 distance bits to the
+        # caller as [Q, k, 2] (churn_lookup_topk merges on them without
+        # re-gathering ids — a [Q·k] row gather costs ~ms at Q=131K)
+        top_dist = (jnp.stack(top_limbs, axis=-1) if fast2_limbs else None)
         # tie-check operands (same layout as the keyed form below)
         tie_a0, tie_a1 = out[0][:, :k + 1], out[1][:, :k + 1]
         tie_av = out[2][:, :k + 1] != gr_sent
@@ -529,7 +589,10 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
         d = [plane[l][:, 1:erow - 1] ^ queries[:, l:l + 1]
              for l in range(nd)]                           # nd × [Q, 3s]
         gr = start[:, None] + jnp.arange(wlen, dtype=jnp.int32)[None, :]
-        inv = (gr >= n_valid).astype(jnp.int32)
+        inv_b = gr >= n_valid
+        if tomb is not None:
+            inv_b = inv_b | tomb
+        inv = inv_b.astype(jnp.int32)
 
         num_keys = 7 if select == "sort" else 3
         out = lax.sort((inv,) + tuple(d) + (gr,),
@@ -546,7 +609,7 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
 
     # window certificate (same argument as window_topk, start = 64j);
     # neighbor rows came along in the gathered row — no extra gather.
-    if top_dist is not None:
+    if select != "fast2":
         kth_ids = xor_ids(queries, top_dist[:, k - 1])
         cp_k = common_bits(queries, kth_ids)
     else:
@@ -599,6 +662,15 @@ def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
     """
     d, idx, cert = expanded_topk(sorted_ids, exp_fast, n_valid, queries,
                                  k=k, select=select, lut=lut, lut_steps=0)
+    # fill_value=0 pads `bad` with duplicate index 0 when fewer than
+    # `cap` rows decertify, so the .at[bad].set scatters below write row
+    # 0 repeatedly.  That is deterministic ONLY because every duplicate
+    # writes an identical value by construction: for a padded entry
+    # was_bad=False, so the write is the row's own current value (and
+    # the cert update ORs a True with anything).  If a future edit makes
+    # per-row scatter values diverge (e.g. mixes in per-slot data), the
+    # duplicates become racy — use a unique fill row or mask first.
+    # (Same invariant as _lookup_engine's compaction in core/search.py.)
     bad = jnp.nonzero(~cert, size=cap, fill_value=0)[0]
     qb = jnp.take(queries, bad, axis=0)
     # full-depth positioning for the rescue rows: 128 rows, cost-free
@@ -678,7 +750,14 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     call.  Returns (dist [Q,k,5], idx [Q,k] int32 into the *sorted*
     table, certified [Q] bool).
     """
-    tile = max(1, min(4096, int(sorted_ids.shape[0])))
+    # Same OOM guard as the sharded shard-local fallback
+    # (parallel/sharded.py): past 8M rows a 4096-row tile's [Q, 4104]x7
+    # u32 sort temps cannot sit alongside the resident table, and the
+    # exact branch's buffers are allocated even when lax.cond never
+    # takes it.  Small tile past 8M — the branch is rare, so its
+    # throughput is secondary to it being allocatable.
+    n_rows = int(sorted_ids.shape[0])
+    tile = max(1, min(4096 if n_rows <= 8_000_000 else 512, n_rows))
     if fallback and not host_fallback:
         return _lookup_topk_device(sorted_ids, expanded, n_valid, queries,
                                    lut, k=k, window=window, select=select,
@@ -706,3 +785,179 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
         dist = dist.at[bad].set(fb_dist)
     idx = idx.at[bad].set(fb_idx)
     return dist, idx, jnp.ones_like(cert)
+
+
+# ---------------------------------------------------------------------------
+# Churn path: append+tombstone lookups without re-sorting (SURVEY §7
+# "incremental updates": append+tombstone slabs with periodic compaction,
+# not per-insert device round-trips; reference mutation path
+# src/routing_table.cpp:204-262).
+#
+# The immutable base (sorted + expanded table) absorbs mutations two ways:
+#   evictions  → one bit in a packed tombstone mask over sorted positions,
+#                folded into the window kernel's invalid lanes
+#                (expanded_topk tomb_bits) — dead rows stay in the array
+#                as mere sort keys;
+#   inserts    → rows of a fixed-capacity *delta slab*, kept as its own
+#                mini sorted+expanded table (re-sorted per mutation
+#                batch — one cheap device sort at slab sizes, amortized
+#                over the batch; a brute-force delta scan would be
+#                O(Q·D) and dominate the whole lookup past D≈1K).
+# A lookup is then: tombstone-masked window top-k over the base, window
+# top-k over the delta, and one [Q, 2k]-wide merge sort.  Correctness
+# never depends on churn volume — heavily-tombstoned windows simply
+# decertify into the exact fallback — so compaction (full re-sort +
+# re-expand) is purely a performance policy, scheduled by core/table.py.
+# ---------------------------------------------------------------------------
+
+_ENC_SENT = 0x7FFFFFFF                  # invalid-lane sentinel (sorts last)
+
+
+def _fallback_tile(n_rows: int, q: int) -> int:
+    """Exact-scan tile for a lax.cond fallback branch: the branch's
+    buffers are ALLOCATED even when never taken, and one merge step
+    holds ~Q·(tile+k)·7 uint32 sort temps.  Cap the product at ~1 GiB
+    (tile floor 512 — the branch is rare, so its throughput is
+    secondary to it being allocatable); same rule served the >8M-row
+    guard in lookup_topk / parallel/sharded.py, generalized to large
+    query batches."""
+    t = 4096
+    while t > 512 and q * t * 28 > (1 << 30):
+        t //= 2
+    return max(1, min(n_rows, t))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps",
+                                             "d_lut_steps"))
+def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
+                      d_sorted, d_expanded, d_n_valid, queries,
+                      lut=None, d_lut=None, *, k: int = 8,
+                      select: str = "fast3", lut_steps=None,
+                      d_lut_steps=None):
+    """Exact k XOR-closest over (live base rows ∪ delta slab).
+
+    Args: base table as in :func:`expanded_topk` (``expanded`` must use
+    a stride divisible by 32), ``tomb_bits`` packed uint32 [ceil(N/32)]
+    over base sorted positions (1 = dead); ``d_sorted``/``d_expanded``/
+    ``d_n_valid`` the delta slab as its own small sorted+expanded table
+    (any stride); optional positioning LUTs (+ ``*_steps``, forwarded
+    to :func:`expanded_topk` — pass 0 for LUT-only positioning when
+    the LUT bits match the table size, the big win at bench scale).
+
+    Returns (dist, idx [Q,k] int32, certified [Q] all-True).  ``idx``
+    encodes the source: values in [0, N) are *sorted positions* of the
+    base; values in [N, N+D) are ``N + delta sorted position``; -1 =
+    fewer than k live rows exist.  ``dist`` is [Q,k,5] for
+    ``select="fast3"``/``"sort"`` (full limbs ride the window sorts —
+    no extra gathers) and ``None`` for ``"fast2"`` (the
+    findClosestNodes contract: nodes, not distances).
+
+    Everything is gather-free past the window row fetches: the merge
+    sorts the *carried* distance keys — 6 operands for fast3, 3 for
+    fast2 (top-64 bits + source key).  fast2's 64-bit merge can tie
+    (p≈2⁻⁴⁷·k per query); ties are detected on the merged k+1 prefix
+    and repaired under a ``lax.cond`` that re-merges on full gathered
+    distances — allocated but ~never executed, like the exact-scan
+    fallbacks that repair uncertified window rows (tombstone-aware for
+    the base; ``_fallback_tile`` bounds every branch's buffers).  The
+    result is unconditionally exact — bit-identical to a full re-sort
+    of the mutated id set (tests/test_table_churn.py proves it against
+    that oracle).
+    """
+    N = sorted_ids.shape[0]
+    D = d_sorted.shape[0]
+    Q = queries.shape[0]
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+    d_n_valid = jnp.asarray(d_n_valid, jnp.int32)
+    big = jnp.uint32(0xFFFFFFFF)
+    fast2 = select == "fast2"
+    nl = 2 if fast2 else N_LIMBS
+
+    m_dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
+                                      queries, k=k, select=select, lut=lut,
+                                      lut_steps=lut_steps,
+                                      tomb_bits=tomb_bits, fast2_limbs=True)
+
+    def exact(_):
+        live = (jnp.arange(N) < n_valid) & ~unpack_tomb_bits(tomb_bits, N)
+        dx, i2 = xor_topk(queries, sorted_ids, k=k,
+                          tile=_fallback_tile(N, Q), valid=live)
+        keep = cert[:, None]
+        return (jnp.where(keep, idx, i2),
+                jnp.where(keep[..., None], m_dist, dx[..., :nl]))
+
+    m_idx, m_dist = lax.cond(jnp.all(cert), lambda _: (idx, m_dist),
+                             exact, operand=None)
+
+    dd, d_idx, d_cert = expanded_topk(d_sorted, d_expanded, d_n_valid,
+                                      queries, k=k, select=select,
+                                      lut=d_lut, lut_steps=d_lut_steps,
+                                      fast2_limbs=True)
+
+    def d_exact(_):
+        dx, i2 = xor_topk(queries, d_sorted, k=k,
+                          tile=_fallback_tile(D, Q),
+                          valid=jnp.arange(D) < d_n_valid)
+        keep = d_cert[:, None]
+        return (jnp.where(keep, d_idx, i2),
+                jnp.where(keep[..., None], dd, dx[..., :nl]))
+
+    d_idx, dd = lax.cond(jnp.all(d_cert), lambda _: (d_idx, dd),
+                         d_exact, operand=None)
+
+    # merge: one sort over 2k candidates per query on the CARRIED
+    # distance keys + a source key.  Invalid lanes get all-ones limbs +
+    # the ENC sentinel; a *real* candidate with an all-ones distance
+    # still wins via the smaller enc key.  Live ids are unique across
+    # base and delta (core/table.py re-adds a revived id to the delta
+    # only while its base position is tombstoned), so full distances
+    # never tie and fast3's 5-limb merge order is exact.
+    m_valid = m_idx >= 0
+    d_valid = d_idx >= 0
+    enc_m = jnp.where(m_valid, m_idx, _ENC_SENT)
+    enc_d = jnp.where(d_valid, d_idx + N, _ENC_SENT)
+    limb_ops = tuple(
+        jnp.concatenate([jnp.where(m_valid, m_dist[..., l], big),
+                         jnp.where(d_valid, dd[..., l], big)], axis=1)
+        for l in range(nl)
+    )
+    enc_all = jnp.concatenate([enc_m, enc_d], axis=1)
+    out = lax.sort(limb_ops + (enc_all,), dimension=1, num_keys=nl + 1)
+    enc_k = out[nl][:, :k]
+    ok = enc_k != _ENC_SENT
+
+    if not fast2:
+        f_idx = jnp.where(ok, enc_k, -1)
+        f_dist = jnp.stack([jnp.where(ok, out[l][:, :k], big)
+                            for l in range(nl)], axis=-1)
+        return f_dist, f_idx, jnp.ones((Q,), bool)
+
+    # fast2: the merge ordered on 64 distance bits only — an adjacent
+    # tie among the first k+1 merged rows means the true 160-bit order
+    # is undetermined.  Repair by re-merging the same 2k candidates on
+    # FULL distances (id gathers live only inside this ~never-taken
+    # branch).
+    kk = min(k + 1, 2 * k)
+    t0, t1, tv = out[0][:, :kk], out[1][:, :kk], out[2][:, :kk] != _ENC_SENT
+    tie = jnp.any((t0[:, 1:] == t0[:, :-1]) & (t1[:, 1:] == t1[:, :-1])
+                  & tv[:, 1:] & tv[:, :-1])
+
+    def exact_merge(_):
+        m_ids = jnp.take(sorted_ids, jnp.clip(m_idx, 0, N - 1).reshape(-1),
+                         axis=0).reshape(Q, k, N_LIMBS)
+        d_ids = jnp.take(d_sorted, jnp.clip(d_idx, 0, D - 1).reshape(-1),
+                         axis=0).reshape(Q, k, N_LIMBS)
+        fm = xor_ids(queries[:, None, :], m_ids)
+        fd = xor_ids(queries[:, None, :], d_ids)
+        ops_f = tuple(
+            jnp.concatenate([jnp.where(m_valid, fm[..., l], big),
+                             jnp.where(d_valid, fd[..., l], big)], axis=1)
+            for l in range(N_LIMBS)
+        ) + (enc_all,)
+        o2 = lax.sort(ops_f, dimension=1, num_keys=N_LIMBS + 1)
+        return o2[N_LIMBS][:, :k]
+
+    enc_k = lax.cond(tie, exact_merge, lambda _: enc_k, operand=None)
+    ok = enc_k != _ENC_SENT
+    f_idx = jnp.where(ok, enc_k, -1)
+    return None, f_idx, jnp.ones((Q,), bool)

@@ -22,4 +22,11 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "slow: long-running live-cluster / subprocess tests")
+        "markers",
+        "slow: long-running live-cluster / subprocess / fuzz tests "
+        "(`-m 'not slow'` = the ~4-minute medium tier)")
+    config.addinivalue_line(
+        "markers",
+        "quick: fast broad-coverage smoke modules — `pytest -m quick` "
+        "is the sub-minute iteration tier; the full suite (CI, "
+        "pre-merge) runs everything")

@@ -172,6 +172,79 @@ def make_goldens() -> dict:
         start = end
     g["value_parts"] = b"".join(parts)
 
+    # ---- conversation-flow goldens (two-node scripted exchanges;
+    # tests/test_wire_conversations.py).  The responder side uses the
+    # peer id the engine fixtures use: sha1("peer") — InfoHash::get is
+    # SHA1 of the data (infohash.h:231-236, src/crypto.cpp:86-88).
+    import hashlib
+    B_ID = hashlib.sha1(b"peer").digest()
+
+    # announce of an oversized value: packValueHeader switches the
+    # "values" array to integer SIZES and streams the blobs as parts
+    # (cpp:889-911; the parts bytes are exactly g["value_parts"] above)
+    abody = (p_map(5) + kv("id", p_bin(MYID)) + kv("h", p_bin(HASH))
+             + kv("values", p_array(1) + p_uint(len(blob)))
+             + kv("c", p_uint(CREATED)) + kv("token", p_bin(TOKEN)))
+    g["announce_big_req"] = outer([kv("a", abody), kv("q", p_str("put"))]
+                                  + trailer(TID_BIN, "q"))
+
+    # responder's confirmation for that announce (cpp:1252-1262)
+    rbody = (p_map(3) + kv("id", p_bin(B_ID)) + kv("vid", p_uint(77))
+             + kv("sa", p_bin(SA4)))
+    g["value_announced_77"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
+    # responder-side pong / listen confirmation (id = B, sa = A's addr)
+    rbody = p_map(2) + kv("id", p_bin(B_ID)) + kv("sa", p_bin(SA4))
+    g["pong_b"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
+    # get-reply carrying the oversized value as sizes + parts — the
+    # reverse-direction fragmentation (cpp:944-1000 values branch →
+    # sendValueParts)
+    rbody = (p_map(4) + kv("id", p_bin(B_ID)) + kv("sa", p_bin(SA4))
+             + kv("token", p_bin(TOKEN))
+             + kv("values", p_array(1) + p_uint(len(blob))))
+    g["nodes_values_sizes"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
+    # the six DhtProtocolException codes (network_engine.h:49-79) as
+    # error packets.  203/401/404 are emitted organically by the request
+    # handlers (src/dht.cpp:2146,2243,2282,2357); 421/422/423 have no
+    # send site in the reference (421 is parse-time drop, 422/423 are
+    # thrown on the receiving side) — their packets exist so the parser
+    # provably accepts any peer that does send them.
+    def err(code: int, text: str, who: bytes) -> bytes:
+        e = p_array(2) + p_int(code) + p_str(text)
+        rbody = p_map(1) + kv("id", p_bin(who))
+        return outer([kv("e", e), kv("r", rbody)] + trailer(TID_BIN, "e"))
+
+    g["error_203_get"] = err(203, "Get_values with no info_hash", B_ID)
+    g["error_401_put"] = err(401, "Put with wrong token", B_ID)
+    g["error_404_refresh"] = err(
+        404, "Access operation for unknown storage", B_ID)
+    g["error_421"] = err(421, "Invalid transaction id size", B_ID)
+    g["error_422"] = err(422, "Can't find transaction", B_ID)
+    g["error_423"] = err(423, "Wrong node info buffer length", B_ID)
+
+    # listen push-channel u-packets (tellListenerRefreshed/Expired,
+    # cpp:186-245): note 't' here is a plain msgpack UINT of the socket
+    # id — the one departure from the bin4 TransId trailer
+    def u_packet(key: str, vids: list) -> bytes:
+        body = (p_map(3) + kv("id", p_bin(B_ID)) + kv("token", p_bin(TOKEN))
+                + kv(key, p_array(len(vids))
+                     + b"".join(p_uint(v) for v in vids)))
+        return outer([kv("u", body), kv("t", p_uint(SID)),
+                      kv("y", p_str("r")), kv("v", p_str(AGENT))])
+
+    g["listen_refreshed_u"] = u_packet("re", [VID, 43])
+    g["listen_expired_u"] = u_packet("exp", [VID, 43])
+
+    # reply with a corrupt n4 blob (25 bytes — not a multiple of the
+    # 26-byte compact node triple): receivers must throw
+    # WRONG_NODE_INFO_BUF_LEN locally (deserializeNodes, cpp:845-851)
+    # and drop, not crash
+    rbody = (p_map(3) + kv("id", p_bin(B_ID)) + kv("sa", p_bin(SA4))
+             + kv("n4", p_bin(b"\xee" * 25)))
+    g["nodes_corrupt_n4"] = outer([kv("r", rbody)] + trailer(TID_BIN, "r"))
+
     return g
 
 

@@ -2,6 +2,9 @@
 (python/opendht.pyx class list) plus NodeSet behavior."""
 
 import opendht_tpu as o
+import pytest
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
 
 
 PYX_SURFACE = [

@@ -2,6 +2,8 @@
 (analogs of reference python/tools/network_monitor.py, dhtcluster.py,
 scanner.py — live-UDP, small sizes)."""
 
+import pytest
+
 import io
 import json
 
@@ -94,6 +96,7 @@ def test_scanner_crawls_local_network():
         net.close()
 
 
+@pytest.mark.slow
 def test_http_server_roundtrip():
     """POST form-encoded put, GET filtered json — the reference tool's
     interface (python/tools/http_server.py:35-67)."""

@@ -9,6 +9,8 @@ import pytest
 from opendht_tpu import crypto
 from opendht_tpu.infohash import InfoHash
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 @pytest.fixture(scope="module")
 def identity():

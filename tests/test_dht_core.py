@@ -16,6 +16,8 @@ from opendht_tpu.sockaddr import SockAddr
 
 from opendht_tpu.testing import VirtualNet
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 def make_net(n: int, **kw) -> VirtualNet:
     net = VirtualNet(**kw)

@@ -28,6 +28,8 @@ from opendht_tpu.net.parsed_message import MessageType, ParsedMessage
 from opendht_tpu.scheduler import Scheduler
 from opendht_tpu.sockaddr import SockAddr
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 GOLDENS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
 
 MYID = bytes(range(20))

@@ -14,6 +14,9 @@ import subprocess
 import sys
 
 import jax
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess driver runs (quick: -m 'not slow')
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -66,7 +69,6 @@ def test_provision_refuses_oversubscription():
     # actionable error, not crash downstream in make_mesh.  Warm it
     # explicitly so the test holds when run in isolation.
     assert len(jax.devices()) == 8
-    import pytest
     import __graft_entry__ as g
     with pytest.raises(RuntimeError, match="fresh process"):
         g._provision_devices(64)

@@ -3,6 +3,8 @@ python/tools/dht/tests.py run at CI scale): latency rounds with churn,
 the node-kill delete test, and maintain_storage persistence — all on the
 deterministic virtual clock."""
 
+import pytest
+
 from opendht_tpu.core.value import Value
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.runtime.config import Config
@@ -48,6 +50,7 @@ def test_delete_reports_holders():
     assert isinstance(survived, bool)
 
 
+@pytest.mark.slow
 def test_persistence_under_churn():
     conf = Config(maintain_storage=True)
     net = build_net(14, seed=4, config=conf)

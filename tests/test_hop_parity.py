@@ -33,6 +33,8 @@ import socket
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # quick tier: -m 'not slow'
+
 from opendht_tpu import InfoHash
 from opendht_tpu.testing import VirtualNet
 

@@ -15,6 +15,8 @@ import jax.numpy as jnp
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.ops import ids as K
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 def _rand_hashes(n, seed):
     rng = np.random.default_rng(seed)

@@ -5,6 +5,8 @@ import pytest
 
 from opendht_tpu.infohash import InfoHash, PkId
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 def test_constructors():
     # tests/infohashtester.cpp:38-74

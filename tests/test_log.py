@@ -5,6 +5,9 @@ import logging
 
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.log import DhtLogger
+import pytest
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
 
 
 class _Capture(logging.Handler):

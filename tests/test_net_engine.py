@@ -22,6 +22,8 @@ from opendht_tpu.net.parsed_message import pack_tid, unpack_tid
 from opendht_tpu.scheduler import Scheduler
 from opendht_tpu.sockaddr import SockAddr
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 class FakeClock:
     def __init__(self):

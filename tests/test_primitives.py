@@ -8,6 +8,9 @@ from opendht_tpu.rate_limiter import RateLimiter
 from opendht_tpu.scheduler import Scheduler
 from opendht_tpu.sockaddr import SockAddr
 from opendht_tpu.utils import TIME_MAX, pack_msg, unpack_msg
+import pytest
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
 
 
 class FakeClock:

@@ -91,6 +91,7 @@ def test_hop_parity_with_scalar_reference():
         np.median(hops_batched), np.median(hops_scalar))
 
 
+@pytest.mark.slow
 def test_scaling_hops_grow_logarithmically():
     m1 = []
     for nsize, seed in ((500, 8), (8000, 9)):
@@ -106,6 +107,7 @@ def test_scaling_hops_grow_logarithmically():
     assert m1[1] - m1[0] <= 6
 
 
+@pytest.mark.slow
 def test_state_limbs_2_bitwise_identical():
     """state_limbs=2 (5-operand merge sorts ranking on the top 64
     distance bits) must be bitwise identical to the exact engine on
@@ -173,6 +175,7 @@ def test_guarded_lower_bound_exact_incl_tie64_tables():
     check(clus, p2, "clustered")
 
 
+@pytest.mark.slow
 def test_survivor_compaction_bitwise_identical():
     """compact_after packs post-cut stragglers into a narrow sub-batch;
     whenever the cap holds, results must be BITWISE identical to the

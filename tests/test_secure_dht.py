@@ -14,6 +14,8 @@ from opendht_tpu.runtime.secure_dht import (
 
 from opendht_tpu.testing import VirtualNet
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 @pytest.fixture(scope="module")
 def identities():

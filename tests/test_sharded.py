@@ -108,6 +108,7 @@ def test_sharded_sort_once_lookup_many(mesh):
         np.testing.assert_array_equal(np.asarray(rows), np.asarray(i_ref))
 
 
+@pytest.mark.slow
 def test_dp_simulate_matches_unsharded(mesh):
     """The data-parallel iterative lookup is bitwise identical to the
     single-device run (the reply model is counter-hashed, not
@@ -201,6 +202,7 @@ def test_sharded_expanded_lookup_matches_full_scan(mesh):
         np.testing.assert_array_equal(np.asarray(rows), np.asarray(i_ref))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("q,t", [(1, 8), (4, 2), (8, 1)])
 def test_tp_simulate_mesh_geometries(q, t):
     """The table-sharded engine must be exact for ANY mesh split — pure

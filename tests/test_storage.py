@@ -11,6 +11,9 @@ from opendht_tpu.core.value import Query, TypeStore, Value, ValueType
 from opendht_tpu.core.value_cache import ValueCache
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.utils import TIME_MAX
+import pytest
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
 
 KEY = InfoHash.get("key")
 

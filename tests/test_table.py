@@ -12,8 +12,11 @@ from opendht_tpu.infohash import InfoHash
 from opendht_tpu.ops import ids as K
 from opendht_tpu.ops import radix
 from opendht_tpu.core.table import (
+
     NodeTable, NODE_GOOD_TIME, TARGET_NODES,
 )
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
 
 
 def _rand_hash(rng):

@@ -507,6 +507,7 @@ def test_expand_table_chunked_matches(n, chunks):
     assert c_np.mean() > 0.9
 
 
+@pytest.mark.slow
 def test_fuzz_kernel_geometries_certified_rows_exact():
     """Randomized sweep: random VALID counts (including < k and == k),
     random invalid fractions, duplicate ids, query hits, across strides

@@ -17,6 +17,8 @@ from opendht_tpu.core.default_types import (
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.sockaddr import SockAddr
 
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
 
 # --------------------------------------------------------------- wire layers
 def test_plain_value_wire_roundtrip():

@@ -1,0 +1,330 @@
+"""Conversation-level wire goldens: scripted two-node exchanges.
+
+Where tests/test_goldens.py freezes single packets, these tests replay
+whole FLOWS through two real NetworkEngines wired back-to-back,
+asserting the transcript bytes in BOTH directions against goldens from
+the independent mini_msgpack encoder (tests/goldens/make_goldens.py),
+plus the protocol behavior at each end:
+
+- fragmented >600 B values: announce (A→B parts + reassembly) and get
+  (B→A parts + reassembly) — sendValueParts/partial-message paths,
+  /root/reference/src/network_engine.cpp:889-941, 431-457;
+- all six DhtProtocolException codes (network_engine.h:49-79): 203,
+  401, 404 emitted organically by request handlers and acted on by the
+  requester (401→announce resend rearm, 404→refresh error cb,
+  dht.cpp:2090-2112); 421 = parse-time drop, 422 = unknown-tid local
+  throw, 423 = corrupt node blob local throw — none may crash or emit;
+- 'sa' NAT address echo round-trip (insertAddr, cpp:636-645 →
+  onReportedAddr);
+- netid-mismatch silent drop (cpp:426-429) and the requester's expiry;
+- listen push-channel u-packets with re/exp id lists (cpp:186-245),
+  including the uint (not bin4) 't' those two messages use.
+"""
+
+import os
+
+import pytest
+
+from opendht_tpu.core.value import Query, Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.net.engine import (DhtProtocolException, EngineCallbacks,
+                                    NetworkEngine, RequestAnswer)
+from opendht_tpu.net.parsed_message import MessageType
+from opendht_tpu.net.request import RequestState
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
+GOLDENS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
+
+MYID = bytes(range(20))                  # A (requester) id
+B_ID = InfoHash.get("peer")              # responder id = sha1("peer")
+HASH = b"\xbb" * 20
+TID = 0x01020304
+SID = 0x05060709
+TOKEN = bytes(range(0x10, 0x18))
+CREATED = 1_700_000_000
+A_ADDR = SockAddr("10.0.0.9", 4009)
+B_ADDR = SockAddr("10.0.0.1", 4000)
+BIG = Value(bytes(range(256)) * 11, type_id=3, value_id=77)   # 2816 B packed
+
+
+def golden(name: str) -> bytes:
+    with open(os.path.join(GOLDENS, name + ".bin"), "rb") as f:
+        return f.read()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class Pair:
+    """Two engines joined by a byte-duplex recording wire.  ``deliver``
+    controls whether bytes are forwarded (False = record only, for the
+    drop tests)."""
+
+    def __init__(self, net_a: int = 0, net_b: int = 0, cbs_b=None,
+                 cbs_a=None):
+        self.clock = _Clock()
+        self.a_out: list = []
+        self.b_out: list = []
+        self.deliver = True               # False = queue; flush() delivers
+        self._pending: list = []
+        self.cbs_a = cbs_a or EngineCallbacks()
+        self.cbs_b = cbs_b or EngineCallbacks()
+        self.a = NetworkEngine(InfoHash(MYID), net_a, self._send_a,
+                               Scheduler(clock=self.clock), self.cbs_a)
+        self.b = NetworkEngine(B_ID, net_b, self._send_b,
+                               Scheduler(clock=self.clock), self.cbs_b)
+
+    def _send_a(self, data, dst) -> int:
+        self.a_out.append(bytes(data))
+        if self.deliver:
+            self.b.process_message(bytes(data), A_ADDR)
+        else:
+            self._pending.append(("b", bytes(data)))
+        return 0
+
+    def _send_b(self, data, dst) -> int:
+        self.b_out.append(bytes(data))
+        if self.deliver:
+            self.a.process_message(bytes(data), B_ADDR)
+        else:
+            self._pending.append(("a", bytes(data)))
+        return 0
+
+    def flush(self) -> None:
+        """Deliver queued packets (deferred mode) until the wire is
+        quiet — packets sent during delivery are delivered too."""
+        while self._pending:
+            to, data = self._pending.pop(0)
+            if to == "b":
+                self.b.process_message(data, A_ADDR)
+            else:
+                self.a.process_message(data, B_ADDR)
+
+    def node_b(self, *tids):
+        """A's cache Node for B with a pinned tid sequence — requests
+        must live on the cache node so B's replies find them."""
+        n = self.a.cache.get_node(B_ID, B_ADDR, self.clock(), confirm=True)
+        seq = list(tids)
+        n.get_new_tid = lambda: seq.pop(0)
+        return n
+
+
+def split_parts(raw: bytes) -> list:
+    """Split a concatenated value_parts golden into packets (each is a
+    standalone msgpack map)."""
+    from opendht_tpu.utils import unpack_stream
+    from opendht_tpu.utils import pack_msg
+    return [pack_msg(o) for o in unpack_stream(raw)]
+
+
+# ------------------------------------------------- fragmentation both ways
+
+def test_conv_big_announce_fragments_and_reassembles():
+    got = {}
+
+    def on_announce(node, h, token, values, created):
+        got.update(h=bytes(h), token=token, values=values, created=created)
+        return RequestAnswer()
+
+    p = Pair(cbs_b=EngineCallbacks(on_announce=on_announce))
+    done = []
+    req = p.a.send_announce_value(p.node_b(TID), InfoHash(HASH), BIG,
+                                  float(CREATED), TOKEN,
+                                  on_done=lambda r, a: done.append(a))
+    # A→B transcript: the sizes-announce then the MTU parts stream
+    assert p.a_out[0] == golden("announce_big_req")
+    assert b"".join(p.a_out[1:]) == golden("value_parts")
+    # B reassembled the full value before dispatching on_announce
+    assert got["h"] == HASH and got["token"] == TOKEN
+    assert got["created"] == CREATED
+    assert len(got["values"]) == 1
+    assert got["values"][0].id == 77 and got["values"][0].data == BIG.data
+    # B confirmed with value_announced(77); A's request completed
+    assert p.b_out == [golden("value_announced_77")]
+    assert req.state is RequestState.COMPLETED
+    assert done and done[0].vid == 77
+
+
+def test_conv_big_get_reply_fragments_and_reassembles():
+    def on_get(node, h, want, query):
+        return RequestAnswer(ntoken=TOKEN, values=[BIG])
+
+    p = Pair(cbs_b=EngineCallbacks(on_get_values=on_get))
+    answers = []
+    req = p.a.send_get_values(p.node_b(TID), InfoHash(HASH), Query(),
+                              on_done=lambda r, a: answers.append(a))
+    assert p.a_out == [golden("get_req")]
+    # B→A: sizes-reply + the same MTU parts stream (reverse direction)
+    assert p.b_out[0] == golden("nodes_values_sizes")
+    assert b"".join(p.b_out[1:]) == golden("value_parts")
+    assert req.state is RequestState.COMPLETED
+    assert answers and answers[0].ntoken == TOKEN
+    assert [v.id for v in answers[0].values] == [77]
+    assert answers[0].values[0].data == BIG.data
+
+
+# --------------------------------------------------------- six error codes
+
+def _raising(exc):
+    def cb(*a, **kw):
+        raise exc
+    return cb
+
+
+def test_conv_error_203_get_no_infohash():
+    exc = DhtProtocolException(DhtProtocolException.NON_AUTHORITATIVE_INFORMATION,
+                               DhtProtocolException.GET_NO_INFOHASH)
+    p = Pair(cbs_b=EngineCallbacks(on_get_values=_raising(exc)))
+    req = p.a.send_get_values(p.node_b(TID), InfoHash(b"\x00" * 20), Query())
+    assert p.b_out == [golden("error_203_get")]
+    # 203 on a get is recorded but not special-cased: the request stays
+    # pending (only 401-announce/listen and 404-refresh rearm/notify)
+    assert req.state is RequestState.PENDING
+
+
+def test_conv_error_401_put_wrong_token_rearms_announce():
+    exc = DhtProtocolException(DhtProtocolException.UNAUTHORIZED,
+                               DhtProtocolException.PUT_WRONG_TOKEN)
+    errors = []
+    p = Pair(cbs_b=EngineCallbacks(on_announce=_raising(exc)),
+             cbs_a=EngineCallbacks(
+                 on_error=lambda r, e: errors.append((r, e.code))))
+    p.deliver = False         # real wires have latency: the error must
+    v = Value(b"hello world", type_id=3, value_id=42)   # arrive AFTER
+    req = p.a.send_announce_value(p.node_b(TID), InfoHash(HASH), v,
+                                  float(CREATED), b"bad-token!")
+    p.flush()                 # sendto() returns, not inside it
+    assert p.b_out == [golden("error_401_put")]
+    # requester side: 401 on an announce rearms the request for resend
+    # with a fresh token (network_engine.cpp:536-554; dht.cpp:2090-2112)
+    assert errors == [(req, 401)]
+    assert req.last_try == float("-inf")
+
+
+def test_conv_error_404_refresh_unknown_storage():
+    exc = DhtProtocolException(DhtProtocolException.NOT_FOUND,
+                               DhtProtocolException.STORAGE_NOT_FOUND)
+    errors = []
+    p = Pair(cbs_b=EngineCallbacks(on_refresh=_raising(exc)),
+             cbs_a=EngineCallbacks(
+                 on_error=lambda r, e: errors.append(e.code)))
+    p.a.send_refresh_value(p.node_b(TID), InfoHash(HASH), 42, TOKEN)
+    assert p.b_out == [golden("error_404_refresh")]
+    assert errors == [404]
+
+
+def test_conv_error_421_truncated_tid_is_parse_dropped():
+    """A packet whose bin 't' is not 4 bytes fails tid parsing and is
+    dropped before dispatch — the reference's parse-drop path
+    (processMessage catch, cpp:418-424; 421 has no send site)."""
+    p = Pair()
+    bad = golden("ping_req").replace(b"t\xc4\x04\x01\x02\x03\x04",
+                                     b"t\xc4\x03\x01\x02\x03")
+    assert bad != golden("ping_req")
+    p.b.process_message(bad, A_ADDR)
+    assert p.b_out == []                  # no pong, no error — dropped
+
+
+def test_conv_error_422_unknown_tid_reply_swallowed():
+    """A reply for a transaction A never issued raises UNKNOWN_TID
+    locally (cpp:521) — no error packet goes out for non-requests."""
+    p = Pair()
+    p.a.process_message(golden("value_announced_77"), B_ADDR)
+    assert p.a_out == []
+    # and receiving a peer-sent 422 error packet parses fine too
+    p.a.process_message(golden("error_422"), B_ADDR)
+    assert p.a_out == []
+
+
+def test_conv_error_423_corrupt_node_blob_dropped():
+    """A find reply whose n4 blob is not a multiple of 26 bytes throws
+    WRONG_NODE_INFO_BUF_LEN during deserializeNodes (cpp:845-851); the
+    request must not complete and nothing is emitted in response."""
+    p = Pair()
+    p.deliver = False                     # hand-deliver the corrupt reply
+    req = p.a.send_find_node(p.node_b(TID), InfoHash(b"\xaa" * 20))
+    p.a_out.clear()
+    p.a.process_message(golden("nodes_corrupt_n4"), B_ADDR)
+    assert p.a_out == []
+    assert req.state is RequestState.PENDING
+
+
+def test_parse_all_six_error_codes():
+    """Every DhtProtocolException code round-trips through the parser
+    with the sender id recovered."""
+    from opendht_tpu.net.parsed_message import ParsedMessage
+    for name, code in (("error_203_get", 203), ("error_401_put", 401),
+                       ("error_404_refresh", 404), ("error_421", 421),
+                       ("error_422", 422), ("error_423", 423)):
+        m = ParsedMessage.from_bytes(golden(name))
+        assert m.type is MessageType.ERROR
+        assert m.error_code == code
+        assert bytes(m.id) == bytes(B_ID)
+
+
+# ------------------------------------------------------------ sa NAT echo
+
+def test_conv_sa_echo_roundtrip():
+    """B echoes A's source address in the pong's 'sa'; A surfaces it via
+    on_reported_addr — the NAT discovery loop."""
+    reported = []
+    p = Pair(cbs_a=EngineCallbacks(
+        on_reported_addr=lambda i, a: reported.append((bytes(i), a))))
+    req = p.a.send_ping(p.node_b(TID))
+    assert p.a_out == [golden("ping_req")]
+    assert p.b_out == [golden("pong_b")]
+    assert req.state is RequestState.COMPLETED
+    (rid, addr), = reported
+    assert rid == bytes(B_ID)
+    assert addr.ip is not None and addr.ip.packed == b"\x0a\x00\x00\x09"
+
+
+# ------------------------------------------------------- netid mismatch
+
+def test_conv_netid_mismatch_drop_and_expiry():
+    """B (network 7) silently drops A's (network 0) ping — no reply, no
+    error — and A's request expires after its 3×1 s attempts."""
+    p = Pair(net_a=0, net_b=7)
+    expired = []
+    req = p.a.send_ping(p.node_b(TID),
+                        on_expired=lambda r, done: expired.append(done))
+    assert p.a_out == [golden("ping_req")]
+    assert p.b_out == []                  # dropped before dispatch
+    for _ in range(8):                    # drive A's retry schedule
+        p.clock.t += 1.0
+        p.a.scheduler.run()
+    assert req.state is RequestState.EXPIRED
+    assert expired and expired[-1] is True
+
+
+# -------------------------------------------------- listen u push channel
+
+def test_conv_listen_u_packets_refreshed_and_expired():
+    p = Pair(cbs_b=EngineCallbacks(
+        on_listen=lambda n, h, t, s, q: RequestAnswer()))
+    pushed = []
+    req = p.a.send_listen(p.node_b(SID, TID), InfoHash(HASH), Query(),
+                          TOKEN, None,
+                          socket_cb=lambda node, msg: pushed.append(msg))
+    assert p.a_out == [golden("listen_req")]
+    assert p.b_out == [golden("pong_b")]  # listen confirmation layout
+    assert req.state is RequestState.COMPLETED
+
+    # B pushes refreshed / expired id lists over the socket channel
+    node_a = p.b.cache.get_node(InfoHash(MYID), A_ADDR, p.clock(),
+                                confirm=True)
+    p.b_out.clear()
+    p.b.tell_listener_refreshed(node_a, SID, InfoHash(HASH), TOKEN, [42, 43])
+    p.b.tell_listener_expired(node_a, SID, InfoHash(HASH), TOKEN, [42, 43])
+    assert p.b_out == [golden("listen_refreshed_u"),
+                       golden("listen_expired_u")]
+    assert [m.refreshed_values for m in pushed] == [[42, 43], []]
+    assert [m.expired_values for m in pushed] == [[], [42, 43]]
