@@ -159,7 +159,7 @@ def config3_tp(Q: int = 0, N: int = 0, limbs: int = 0) -> dict:
 
 
 def config3(Q: int = 0, N: int = 0, chunk: int = 0,
-            limbs: int = 0) -> dict:
+            limbs: int = 0, latency: bool = False) -> dict:
     """α-parallel iterative lookups to k=8 convergence.
 
     The north-star shape is ``-Q 1000000`` against the 10M-node table
@@ -231,15 +231,52 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0,
                           r1=1, r2=4)
     dt = wave_dt * n_waves
     p50_wave = min((Q // 2) // chunk, n_waves - 1)
-    return {"metric": "config3 iterative search sim, alpha=3 k=8, "
-                      "%d lookups x %d nodes, %d waves of %d; p50 hops %d, "
-                      "converged %.3f, p50 burst completion %.3fs "
-                      "(wave chain slope %.3fs)"
-                      % (Q, N, n_waves, chunk,
-                         int(np.percentile(hops, 50)), conv,
-                         wave_dt * (p50_wave + 1), wave_dt),
-            "value": round(Q / dt, 1), "unit": "lookups/s/chip",
-            "vs_baseline": None}
+    out = {"metric": "config3 iterative search sim, alpha=3 k=8, "
+                     "%d lookups x %d nodes, %d waves of %d; p50 hops %d, "
+                     "converged %.3f, p50 burst completion %.3fs "
+                     "(wave chain slope %.3fs)"
+                     % (Q, N, n_waves, chunk,
+                        int(np.percentile(hops, 50)), conv,
+                        wave_dt * (p50_wave + 1), wave_dt),
+           "value": round(Q / dt, 1), "unit": "lookups/s/chip",
+           "vs_baseline": None}
+    if not latency:
+        return out
+
+    # ---- per-lookup LATENCY (verdict r3 #3: the BASELINE "<1 ms p50
+    # per lookup" has a latency reading, not just amortized
+    # throughput).  A lookup's latency is its wave's completion time:
+    # per-wave chain slopes vary with the wave's straggler hop count,
+    # so sample ≤16 waves across the burst for a p50/p95 histogram
+    # (one compile serves all same-shape waves), then sweep smaller
+    # wave widths — the low-latency mode trades throughput for wave
+    # time.
+    sample_idx = sorted(set(
+        int(i) for i in np.linspace(0, n_waves - 1,
+                                    num=min(16, n_waves))))
+    wave_ms = [1e3 * chain_slope(body, waves[i], sorted_ids, n_valid, lut,
+                                 r1=1, r2=4)
+               for i in sample_idx]
+    out["wave_ms_p50"] = round(float(np.percentile(wave_ms, 50)), 2)
+    out["wave_ms_p95"] = round(float(np.percentile(wave_ms, 95)), 2)
+    out["wave_ms_sampled"] = [round(m, 2) for m in wave_ms]
+
+    sweep = {}
+    for c in (1024, 4096, chunk):
+        if c > Q or c in sweep:
+            continue
+        w = targets[:c]
+        cdt = chain_slope(body, w, sorted_ids, n_valid, lut, r1=1, r2=4)
+        sweep[c] = {"latency_ms": round(cdt * 1e3, 2),
+                    "lookups_per_s": round(c / cdt, 1)}
+    out["latency_sweep"] = sweep
+    out["metric"] += ("; LATENCY reading: wave completion p50 %.1f ms / "
+                      "p95 %.1f ms (a lookup's latency = its wave's "
+                      "completion; amortized per-lookup time is NOT a "
+                      "latency), small-wave sweep %s"
+                      % (out["wave_ms_p50"], out["wave_ms_p95"],
+                         json.dumps(sweep, sort_keys=True)))
+    return out
 
 
 def config4() -> dict:
@@ -407,7 +444,7 @@ def config2() -> dict:
     return out
 
 
-def config6(churn: int = 0) -> dict:
+def config6(churn: int = 0, dcap: int = 0) -> dict:
     """Sustained churn: mutations absorbed WHILE lookups run (SURVEY §7
     "incremental updates" — the round-3 verdict's top ask; reference
     mutation path src/routing_table.cpp:204-262).
@@ -449,7 +486,7 @@ def config6(churn: int = 0) -> dict:
     on_accel = jax.devices()[0].platform != "cpu"
     N = 10_000_000 if on_accel else 200_000
     Q = 131_072 if on_accel else 8_192
-    DCAP = 262_144 if on_accel else 8_192
+    DCAP = dcap or (262_144 if on_accel else 8_192)
     E = churn or (256 if on_accel else 64)      # evictions AND inserts/round
     K = 8
     lut_bits = default_lut_bits(N)
@@ -507,8 +544,13 @@ def config6(churn: int = 0) -> dict:
                 jnp.asarray(new_ids), nd0)
 
     # advance to a representative mid-cycle state (half the compaction
-    # cycle) so the timed round sees realistic tombstone/delta volume
+    # cycle) so the timed round sees realistic tombstone/delta volume;
+    # warm_rounds * E (the warm loop + the timed round's inserts) must
+    # fit the slab — small --dcap / big --churn would overflow delta_np
+    if E > DCAP:
+        raise ValueError(f"--churn {E} exceeds delta capacity {DCAP}")
     warm_rounds = max(4, (DCAP // E) // 2) if on_accel else 8
+    warm_rounds = max(1, min(warm_rounds, DCAP // E))
     t0 = __import__("time").perf_counter()
     for _ in range(warm_rounds - 1):
         prep_round()
@@ -643,6 +685,12 @@ def main(argv=None) -> int:
                         "merge sorts (2 = fast default, 5 = exact-order)")
     p.add_argument("--churn", type=int, default=0,
                    help="config6: evictions (= inserts) per round")
+    p.add_argument("--dcap", type=int, default=0,
+                   help="config6: delta slab capacity (trades delta "
+                        "lookup cost vs compaction frequency)")
+    p.add_argument("--latency", action="store_true",
+                   help="config3: add the per-wave completion-time "
+                        "histogram + small-wave latency sweep")
     args = p.parse_args(argv)
     todo = [args.config] if args.config else sorted(CONFIGS)
     for c in todo:
@@ -653,9 +701,9 @@ def main(argv=None) -> int:
         kw = {}
         if c == 3:
             kw = {"Q": args.Q, "N": args.N, "chunk": args.chunk,
-                  "limbs": args.limbs}
+                  "limbs": args.limbs, "latency": args.latency}
         elif c == 6:
-            kw = {"churn": args.churn}
+            kw = {"churn": args.churn, "dcap": args.dcap}
         print(json.dumps(CONFIGS[c](**kw)))
     return 0
 

@@ -1,0 +1,165 @@
+"""Per-stage chain-slope profile of the iterative lookup engine.
+
+The config-3 wave (core/search.py simulate_lookups) is a while-loop of
+rounds; this driver times each round *component* as its own
+device-serialized chain so the next optimization targets the measured
+dominator, the method that produced round 3's 63K→171K (profile →
+rebuild the dominant stage).  Stages replicate the engine's round
+pieces with the same primitives (single-device gather/lower exactly as
+simulate_lookups builds them — core/search.py:481-553); the full-wave
+number ties the decomposition back to config 3.
+
+Usage::  python benchmarks/profile_search.py [-N 10000000] [-W 16384]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-N", type=int, default=0)
+    p.add_argument("-W", type=int, default=0, help="wave width")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from bench import chain_slope
+    from opendht_tpu.ops.ids import N_LIMBS
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits)
+    from opendht_tpu.core import search as SE
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = args.N or (10_000_000 if on_accel else 100_000)
+    W = args.W or (16_384 if on_accel else 1_024)
+    NL = 2                                  # state_limbs=2 (config3 default)
+    ALPHA, S, K = 3, 14, 8
+    R = ALPHA * K
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    targets = jax.random.bits(k2, (W, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+    n = jnp.asarray(n_valid, jnp.int32)
+    sorted_t = sorted_ids.T
+
+    # the same primitives simulate_lookups injects (search.py:535-551)
+    lower = SE._guarded_lower_bound(sorted_ids, n, lut)
+
+    def gather_planar(rows, limbs=N_LIMBS):
+        flat = jnp.clip(rows, 0, N - 1).reshape(-1)
+        g = jnp.take(sorted_t[:limbs], flat, axis=1)
+        return [g[l].reshape(rows.shape) for l in range(limbs)]
+
+    def stage(name, body, *consts, r1=2, r2=8):
+        dt = chain_slope(body, targets, *consts, r1=r1, r2=r2)
+        rec = {"stage": name, "ms": round(dt * 1e3, 3)}
+        print(json.dumps(rec), flush=True)
+        return dt
+
+    # representative per-round operands
+    rng = np.random.default_rng(0)
+    x_rows = jnp.asarray(rng.integers(0, N, size=(W, ALPHA), dtype=np.int32))
+    new_rows = jnp.asarray(rng.integers(0, N, size=(W, R), dtype=np.int32))
+    cand_node = jnp.asarray(rng.integers(0, N, size=(W, S), dtype=np.int32))
+    cand_l = [jax.random.bits(jax.random.PRNGKey(7 + l), (W, S),
+                              dtype=jnp.uint32) for l in range(NL)]
+    queried = jnp.asarray((rng.random((W, S)) < 0.5).astype(np.int32))
+
+    # s1: positioning of the full wave (runs once per wave)
+    def s1(q, n_):
+        return jnp.sum(lower(q).astype(jnp.float32))
+    stage("s1 lower(targets) [once/wave]", s1, n, r1=4, r2=16)
+
+    # s2: the per-round positioning load — prefix block bounds run ONE
+    # batched lower over [2*W*alpha] rows (search.py:86-110)
+    def s2(q, xr, n_):
+        x_l = gather_planar(xr, N_LIMBS)
+        t_l = [q[:, l:l + 1] for l in range(N_LIMBS)]
+        b = SE._common_bits_planar(x_l, t_l)
+        lo, ub = SE._prefix_block_bounds(
+            lower, n_, q[:, None, :].repeat(ALPHA, 1),
+            jnp.clip(b + 1, 0, SE.ID_BITS))
+        return jnp.sum((ub - lo).astype(jnp.float32))
+    stage("s2 reply positioning (2*W*alpha lower)", s2, x_rows, n)
+
+    # s3: reply id gather [W, R] x NL planes (the merge's new-candidate
+    # distance fetch).  The gather indices are perturbed by q so the
+    # stage consumes the rep-perturbed input — chain_slope's
+    # anti-elision contract (an un-consumed q lets XLA hoist the whole
+    # body out of the rep loop and the slope measures a scalar add)
+    def s3(q, nr):
+        nr2 = (nr + (q[:, :1].astype(jnp.int32) & 1)) % N
+        g = gather_planar(nr2, NL)
+        return sum(jnp.sum(x.astype(jnp.float32)) * 1e-9 for x in g)
+    stage("s3 reply gather [W,R] x %d limbs" % NL, s3, new_rows)
+
+    # s4: the two merge sorts (insert + dedupe, search.py:298-337)
+    def s4(q, cn, ql, nr, *cl):
+        cl = list(cl)
+        new_l = gather_planar(nr, NL)
+        node = jnp.concatenate([cn, nr], axis=1)
+        d_l = [jnp.concatenate([cl[l], new_l[l] ^ q[:, l:l + 1]], axis=1)
+               for l in range(NL)]
+        qd = jnp.concatenate([ql, jnp.zeros((W, R), jnp.int32)], axis=1)
+        inv = (node < 0).astype(jnp.int32)
+        from jax import lax
+        out = lax.sort((inv,) + tuple(d_l) + (node, 1 - qd),
+                       dimension=1, num_keys=3 + NL)
+        node_s = out[1 + NL]
+        dup = jnp.concatenate(
+            [jnp.zeros((W, 1), bool),
+             (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)],
+            axis=1)
+        inv2 = jnp.where(dup, 1, out[0])
+        out2 = lax.sort((inv2,) + tuple(out[1:1 + NL]) + (node_s, out[2 + NL]),
+                        dimension=1, num_keys=2 + NL)
+        return jnp.sum(out2[1 + NL][:, :S].astype(jnp.float32)) * 1e-9
+    stage("s4 merge sorts (2x [W,%d])" % (S + R), s4, cand_node, queried,
+          new_rows, *cand_l)
+
+    # s5: candidate alpha-selection (masked max-reductions); cn is
+    # perturbed by q for the same anti-elision reason as s3
+    def s5(q, cn, ql):
+        cn = cn + (q[:, :1].astype(jnp.int32) & 1)
+        can = (cn >= 0) & (ql == 0)
+        rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+        sel = can & (rank <= ALPHA)
+        xr = jnp.stack([jnp.max(jnp.where(sel & (rank == j + 1), cn, -1),
+                                axis=1) for j in range(ALPHA)], axis=1)
+        return jnp.sum(xr.astype(jnp.float32)) * 1e-9
+    stage("s5 alpha-select reductions", s5, cand_node, queried,
+          r1=8, r2=64)
+
+    # full wave for reference (ties the decomposition to config 3)
+    def wave(q, si, nv, l):
+        o = SE.simulate_lookups(si, nv, q, alpha=ALPHA, k=K, lut=l,
+                                state_limbs=NL)
+        return (jnp.sum(o["hops"].astype(jnp.float32))
+                + jnp.sum(o["converged"].astype(jnp.float32)))
+    dt = stage("wave simulate_lookups [W=%d]" % W, wave, sorted_ids,
+               n_valid, lut, r1=1, r2=4)
+    hops_out = jax.block_until_ready(SE.simulate_lookups(
+        sorted_ids, n_valid, targets, alpha=ALPHA, k=K, lut=lut,
+        state_limbs=NL))
+    p50 = int(np.percentile(np.asarray(hops_out["hops"]), 50))
+    print(json.dumps({"stage": "summary", "wave_ms": round(dt * 1e3, 2),
+                      "p50_hops": p50,
+                      "lookups_per_s": round(W / dt, 1)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

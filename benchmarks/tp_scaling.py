@@ -1,0 +1,202 @@
+"""Table-sharded iterative search: collective-volume + scaling evidence.
+
+Verdict-r3 ask #5: make "O(queries), never O(table)" a MEASURED table.
+On an 8-virtual-device CPU mesh (the same environment the driver's
+``dryrun_multichip`` uses — real multi-chip hardware is not available
+here) this driver, for n_t ∈ {1, 2, 4, 8} with n_q = 8/n_t on a fixed
+global table:
+
+1. compiles ``parallel.build_tp_lookup`` and EXTRACTS the collectives
+   from the compiled HLO — op kind, output shape, bytes — so the wire
+   volume per hop is read off the actual executable, not just the
+   analytic model (psum positioning + psum row fetch,
+   opendht_tpu/parallel/sharded.py:305-341);
+2. checks the per-hop collective bytes scale with the QUERY batch and
+   are independent of the table shard size (the whole point of the
+   design: a bigger table costs no more wire);
+3. records relative wall-clock per call.  CPU-mesh wall-clock measures
+   compute + memory only — virtual devices share one host, so this is
+   a scaling-shape indicator, NOT an ICI latency measurement (stated
+   in the artifact).
+
+Writes ``TP_SCALING.json`` at the repo root (next to the MULTICHIP
+artifacts) and prints one JSON line per geometry.  Usage::
+
+    python benchmarks/tp_scaling.py [-N 262144] [-Q 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {"u32": 4, "s32": 4, "f32": 4, "u64": 8, "s64": 8,
+                "u16": 2, "s16": 2, "u8": 1, "s8": 1, "pred": 1,
+                "bf16": 2, "f64": 8}
+
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:%\S+\s*=\s*)?"
+    r"\(?((?:[a-z0-9]+\[[0-9,]*\][^)]*?)(?:,\s*[a-z0-9]+\[[0-9,]*\][^)]*?)*)\)?"
+    r"\s*(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter)\(", re.M)
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shapes: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shapes):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+_COLLECTIVE_LINE_RE = re.compile(
+    r"=\s*(\(?[a-z0-9]+\[[^=]*?)\s*"
+    r"(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter)"
+    r"\(")
+
+
+def collectives_of(hlo_text: str) -> dict:
+    """Collectives in the compiled module, attributed IN-LOOP (execute
+    once per hop) vs ONE-SHOT (once per call).
+
+    Not every collective runs per hop: the engine issues psums before
+    the while-loop (initial positioning + the bootstrap round) and one
+    after (the final 5-limb id reconstruction) — core/search.py:259,
+    339-352, 463 — so counting the whole module as per-hop overstates
+    wire volume ~2×.  Attribution reads each instruction's ``op_name``
+    metadata, which carries the full trace path: collectives lowered
+    from inside the hop loop are tagged ``…/while/body/…``.
+    """
+    per_hop, one_shot = [], []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_LINE_RE.search(line)
+        if not m:
+            continue
+        rec = {"op": m.group(2), "bytes": _shape_bytes(m.group(1))}
+        (per_hop if "/while/body/" in line else one_shot).append(rec)
+    return {"per_hop": per_hop, "one_shot": one_shot}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-N", type=int, default=262_144)
+    p.add_argument("-Q", type=int, default=4_096)
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args(argv)
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from opendht_tpu.ops.sorted_table import sort_table, default_lut_bits
+    from opendht_tpu.core.search import ALPHA, SEARCH_NODES
+    from opendht_tpu.parallel.sharded import build_tp_lookup
+
+    devs = np.array(jax.devices())
+    assert len(devs) == 8, devs
+    N, Q = args.N, args.Q
+    MAX_HOPS = 48
+    k1, k2 = jax.random.split(jax.random.PRNGKey(11))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    targets = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    nv = jnp.asarray(n_valid, jnp.int32)
+
+    rows = []
+    ref_nodes = None
+    for n_t in (1, 2, 4, 8):
+        n_q = 8 // n_t
+        mesh = Mesh(devs.reshape(n_q, n_t), ("q", "t"))
+        shard_n = N // n_t
+        fn = build_tp_lookup(mesh, shard_n, Q, 8, ALPHA, SEARCH_NODES,
+                             MAX_HOPS, default_lut_bits(shard_n),
+                             state_limbs=2)
+        s_pl = jax.device_put(sorted_ids, NamedSharding(mesh, P("t", None)))
+        t_pl = jax.device_put(targets, NamedSharding(mesh, P("q", None)))
+        seed = jnp.int32(1)
+
+        lowered = fn.lower(s_pl, nv, t_pl, seed)
+        hlo = lowered.compile().as_text()
+        attributed = collectives_of(hlo)
+        colls = attributed["per_hop"]
+        per_hop = sum(c["bytes"] for c in colls)
+        one_shot = sum(c["bytes"] for c in attributed["one_shot"])
+        by_kind: dict = {}
+        for c in colls:
+            by_kind[c["op"]] = by_kind.get(c["op"], 0) + c["bytes"]
+
+        out = jax.block_until_ready(fn(s_pl, nv, t_pl, seed))   # warm + check
+        nodes = np.asarray(out["nodes"])
+        if ref_nodes is None:
+            ref_nodes = nodes
+        else:
+            np.testing.assert_array_equal(nodes, ref_nodes)     # bit-identical
+        best = None
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(s_pl, nv, t_pl, seed))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+
+        hops = np.asarray(out["hops"])
+        # HLO is SPMD — one program per device — so instruction output
+        # bytes are PER-DEVICE volumes.  Normalizing by the device's
+        # local query slice (q_local = Q / n_q) gives the invariant the
+        # design claims: bytes per query per hop per device do not grow
+        # with the table shard (or with n_t), only with queries.
+        q_local = Q // n_q
+        row = {
+            "n_t": n_t, "n_q": n_q, "shard_rows": shard_n, "Q": Q, "N": N,
+            "collective_sites_in_loop": len(colls),
+            "collective_sites_one_shot": len(attributed["one_shot"]),
+            "collective_bytes_per_hop_per_device": per_hop,
+            "collective_bytes_one_shot_per_device": one_shot,
+            "collective_bytes_by_kind": by_kind,
+            "bytes_per_local_query_per_hop": round(per_hop / q_local, 1),
+            "p50_hops": int(np.percentile(hops, 50)),
+            "converged": float(np.asarray(out["converged"]).mean()),
+            "wallclock_s": round(best, 4),
+            "lookups_per_s_virtual": round(Q / best, 1),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    artifact = {
+        "metric": "tp_simulate_lookups collective volume + scaling, "
+                  "8 virtual CPU devices (mesh q x t), fixed table",
+        "note": "collective bytes read from the compiled HLO, attributed "
+                "in-loop (once per hop of the while-loop body's call "
+                "graph) vs one-shot (positioning before / id "
+                "reconstruction after the loop); wall-clock on a "
+                "virtual CPU mesh indicates scaling shape only — "
+                "virtual devices share one host, ICI is not modeled. "
+                "Results bit-identical across every geometry.",
+        "rows": rows,
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "TP_SCALING.json"), "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"wrote": "TP_SCALING.json",
+                      "geometries": len(rows)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -574,3 +574,57 @@ def test_fuzz_kernel_geometries_certified_rows_exact():
             np.testing.assert_array_equal(
                 np.asarray(i2)[c2_np], np.asarray(i_ref)[c2_np],
                 err_msg=str((trial, "cascade")))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [8, 16])
+@pytest.mark.parametrize("cap", [8, 64, 512])
+def test_fuzz_cascade_cap_overflow_graceful(k, cap):
+    """cascade_topk (the headline kernel) under adversarial clustering,
+    across caps and both stage strides: rows neither stage certifies —
+    including cap OVERFLOW, where more rows decertify than stage 2 can
+    rescue — must come back certified=False (never silently wrong),
+    certified rows must equal the oracle, the host fallback must
+    restore exactness, and results must be deterministic (the
+    duplicate-fill-row scatter writes are value-identical by
+    construction — see cascade_topk's fill_value comment)."""
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
+                                              cascade_topk)
+    from opendht_tpu.ops.xor_topk import xor_topk
+    rng = np.random.default_rng(4242)
+    NSLAB, NQ = 3072, 64
+    raw = rng.integers(0, 256, size=(NSLAB, 20), dtype=np.uint8)
+    # 80% of rows share a 12-byte prefix: in-cluster neighbors agree on
+    # ≥96 bits while fast2's cp lower bound clamps at 64, so NEITHER
+    # stage can certify in-cluster queries — every one overflows any cap
+    raw[: 4 * NSLAB // 5, :12] = raw[0, :12]
+    ids = jnp.asarray(K.ids_from_bytes(raw))
+    sorted_ids, perm, n_valid = sort_table(ids)
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    exp64 = expand_table(sorted_ids)
+    q_raw = raw[rng.integers(0, 4 * NSLAB // 5, NQ)].copy()
+    q_raw[:, 19] ^= rng.integers(1, 255, NQ, dtype=np.uint8)  # near-hits
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    d_ref, i_ref = xor_topk(q, sorted_ids, k=k)
+    i_ref = np.asarray(i_ref)
+
+    for stride in (24, 32):
+        exp_s = expand_table(sorted_ids, stride=stride)
+        _d, i1, c1 = cascade_topk(sorted_ids, exp_s, exp64, n_valid, q,
+                                  lut, k=k, select="fast2", cap=cap)
+        _d, i1b, c1b = cascade_topk(sorted_ids, exp_s, exp64, n_valid, q,
+                                    lut, k=k, select="fast2", cap=cap)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i1b))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c1b))
+        i1, c1 = np.array(i1), np.asarray(c1)
+        ctx = (k, cap, stride)
+        # the adversarial cluster defeats both stages (cap=8 is the
+        # overflow case: more uncertified rows than stage 2 can rescue)
+        assert (~c1).any(), ctx
+        np.testing.assert_array_equal(i1[c1], i_ref[c1], err_msg=str(ctx))
+        # graceful overflow: flagged rows repair exactly on the host
+        bad = np.nonzero(~c1)[0]
+        if len(bad):
+            _fd, fi = xor_topk(q[bad], sorted_ids, k=k)
+            i1[bad] = np.asarray(fi)
+        np.testing.assert_array_equal(i1, i_ref, err_msg=str(ctx))
