@@ -73,6 +73,9 @@ def best_of(fn, tries: int = 3):
     return best
 
 
+_CHAIN_CACHE: dict = {}      # body function object -> jitted rep chain
+
+
 def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
                 tries: int = 3, samples: int = 0):
     """Per-rep device time of ``body`` via the serialized-chain slope:
@@ -96,20 +99,32 @@ def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
     table into the compile request (measured: a closed-over 480 MB
     expanded table pushed one compile past 20 minutes; as an argument
     it adds nothing).
+
+    The jitted rep chain is cached per ``body`` IDENTITY: repeated
+    calls with the same body function object (e.g. a per-wave latency
+    histogram sweeping many same-shape inputs) reuse one executable —
+    a fresh inner ``jax.jit`` per call would retrace and recompile
+    every time, which on the remote-compile tunnel costs minutes per
+    sample.
     """
-    @jax.jit
-    def g(x, reps, *a):
-        def cond(c):
-            return c[0] < reps
-        def step(c):
-            i, acc = c
-            return i + 1, acc + body(x ^ i.astype(x.dtype), *a)
-        # while_loop with a *traced* trip count: one executable serves
-        # every rep count (the second compile would otherwise dominate
-        # multi-minute workloads on the remote-compile tunnel), and the
-        # dynamic bound forbids unrolling/CSE across reps by construction
-        return lax.while_loop(cond, step,
-                              (jnp.int32(0), jnp.zeros((), jnp.float32)))[1]
+    g = _CHAIN_CACHE.get(body)
+    if g is None:
+        @jax.jit
+        def g(x, reps, *a):
+            def cond(c):
+                return c[0] < reps
+            def step(c):
+                i, acc = c
+                return i + 1, acc + body(x ^ i.astype(x.dtype), *a)
+            # while_loop with a *traced* trip count: one executable
+            # serves every rep count (the second compile would
+            # otherwise dominate multi-minute workloads on the
+            # remote-compile tunnel), and the dynamic bound forbids
+            # unrolling/CSE across reps by construction
+            return lax.while_loop(cond, step,
+                                  (jnp.int32(0),
+                                   jnp.zeros((), jnp.float32)))[1]
+        _CHAIN_CACHE[body] = g
 
     for attempt in range(3):                      # compile + warm; the
         try:                                      # remote-compile tunnel

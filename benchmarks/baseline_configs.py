@@ -261,8 +261,9 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0,
     out["wave_ms_p95"] = round(float(np.percentile(wave_ms, 95)), 2)
     out["wave_ms_sampled"] = [round(m, 2) for m in wave_ms]
 
-    sweep = {}
-    for c in (1024, 4096, chunk):
+    sweep = {chunk: {"latency_ms": round(wave_dt * 1e3, 2),
+                     "lookups_per_s": round(chunk / wave_dt, 1)}}
+    for c in (1024, 4096):
         if c > Q or c in sweep:
             continue
         w = targets[:c]
@@ -547,10 +548,11 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     # cycle) so the timed round sees realistic tombstone/delta volume;
     # warm_rounds * E (the warm loop + the timed round's inserts) must
     # fit the slab — small --dcap / big --churn would overflow delta_np
-    if E > DCAP:
-        raise ValueError(f"--churn {E} exceeds delta capacity {DCAP}")
+    if 2 * E > DCAP:
+        raise ValueError(f"--churn {E}: the warm round + the timed round "
+                         f"need 2*E <= delta capacity (DCAP={DCAP})")
     warm_rounds = max(4, (DCAP // E) // 2) if on_accel else 8
-    warm_rounds = max(1, min(warm_rounds, DCAP // E))
+    warm_rounds = max(2, min(warm_rounds, DCAP // E))
     t0 = __import__("time").perf_counter()
     for _ in range(warm_rounds - 1):
         prep_round()

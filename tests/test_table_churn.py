@@ -262,6 +262,28 @@ def test_nodetable_delta_overflow_forces_compaction():
     assert t._snap is None                         # overflow → rebuild due
 
 
+def test_nodetable_bulk_load_absorbed_into_delta():
+    """bulk_load lands in the delta when it fits (base snapshot kept);
+    oversized loads fall back to full invalidation."""
+    rng = np.random.default_rng(11)
+    self_id = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+    t = NodeTable(self_id, capacity=1024, k=64, delta_cap=32)
+    ids0 = rng.integers(0, 2**32, size=(100, 5), dtype=np.uint32)
+    t.bulk_load(ids0, now=1.0)
+    t.snapshot(now=2.0)
+    base = t._snap
+    small = rng.integers(0, 2**32, size=(16, 5), dtype=np.uint32)
+    t.bulk_load(small, now=3.0)
+    assert t._snap is base and t.churn_pending == 16
+    # lookup through the churn view sees the new rows
+    q = small[:1]
+    rows, _ = t.view(3.0).lookup(q, k=1)
+    assert np.array_equal(t._ids[int(rows[0, 0])], small[0])
+    big = rng.integers(0, 2**32, size=(64, 5), dtype=np.uint32)
+    t.bulk_load(big, now=4.0)              # 16 + 64 > delta_cap=32
+    assert t._snap is None                 # full rebuild due
+
+
 def test_nodetable_host_scan_thresholds():
     """find_closest routes small workloads to the host scan (no
     snapshot build at all) and equals the device view on demand."""
