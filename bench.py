@@ -73,7 +73,14 @@ def best_of(fn, tries: int = 3):
     return best
 
 
-_CHAIN_CACHE: dict = {}      # body function object -> jitted rep chain
+# body function object -> jitted rep chain.  Bounded FIFO: the jitted
+# chain g closes over `body`, so a WeakKeyDictionary would never
+# collect (value → key strong ref); instead old entries are evicted
+# once the cache exceeds the cap, which frees per-call lambdas (e.g. a
+# sweep loop creating a fresh body per width) and their executables in
+# long-running bench processes.
+_CHAIN_CACHE: dict = {}
+_CHAIN_CACHE_MAX = 32
 
 
 def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
@@ -124,6 +131,8 @@ def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
             return lax.while_loop(cond, step,
                                   (jnp.int32(0),
                                    jnp.zeros((), jnp.float32)))[1]
+        while len(_CHAIN_CACHE) >= _CHAIN_CACHE_MAX:
+            _CHAIN_CACHE.pop(next(iter(_CHAIN_CACHE)))
         _CHAIN_CACHE[body] = g
 
     for attempt in range(3):                      # compile + warm; the
@@ -211,9 +220,13 @@ def measure(samples: int = 5) -> dict:
     sorted_ids, perm, n_valid = jax.block_until_ready(sort_table(table))
     lut = jax.block_until_ready(
         build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
+    # 2-PLANE expansions (round 5): the fast2 sort + clamped certificate
+    # consume limb planes 0-1 only, so the gathered row carries 2 planes
+    # instead of 5 — 60% off the dominant row-gather traffic,
+    # bit-identical results (tests/test_topk.py pins it)
     exp_fast = jax.block_until_ready(
-        expand_table(sorted_ids, stride=HEADLINE_STRIDE))
-    exp_wide = jax.block_until_ready(expand_table(sorted_ids))
+        expand_table(sorted_ids, stride=HEADLINE_STRIDE, limbs=2))
+    exp_wide = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
 
     def lookup(q, sorted_ids, exp_fast, exp_wide, n_valid, lut):
         # fast2 = the findClosestNodes contract (nodes, not distances):
@@ -223,7 +236,7 @@ def measure(samples: int = 5) -> dict:
         # (HEADLINE_CAP bounds the repair batch)
         d, idx, c = cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid,
                                  q, lut, k=K, select="fast2",
-                                 cap=HEADLINE_CAP)
+                                 cap=HEADLINE_CAP, planes=2)
         return (jnp.sum(c.astype(jnp.float32))
                 + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
 
@@ -240,10 +253,10 @@ def measure(samples: int = 5) -> dict:
     # exact fallback — count it honestly
     _, _, cert1 = jax.block_until_ready(
         expanded_topk(sorted_ids, exp_fast, n_valid, queries, k=K,
-                      select="fast2", lut=lut, lut_steps=0))
+                      select="fast2", lut=lut, lut_steps=0, planes=2))
     _, i2, cert = jax.block_until_ready(
         cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries,
-                     lut, k=K, select="fast2", cap=HEADLINE_CAP))
+                     lut, k=K, select="fast2", cap=HEADLINE_CAP, planes=2))
     cert_np = np.asarray(cert)
     cert_frac = float(cert_np.mean())
     stage2_rows = int((~np.asarray(cert1)).sum())
@@ -253,9 +266,12 @@ def measure(samples: int = 5) -> dict:
     # the oracle's node order on every certified row (residual
     # uncertified rows go to lookup_topk's host fallback — none occur on
     # uniform tables), and the fuller fast3 path the distances too
+    # (fast3 needs all 5 planes — built transiently for the check only)
+    exp_fast5 = expand_table(sorted_ids, stride=HEADLINE_STRIDE)
     d3, i3, _ = jax.block_until_ready(
-        expanded_topk(sorted_ids, exp_fast, n_valid, queries[:256], k=K,
+        expanded_topk(sorted_ids, exp_fast5, n_valid, queries[:256], k=K,
                       lut=lut, lut_steps=0))
+    del exp_fast5
     d_ref, i_ref = xor_topk(queries[:256], sorted_ids, k=K,
                             valid=jnp.arange(N) < n_valid)
     c256 = cert_np[:256]
@@ -310,6 +326,7 @@ def measure(samples: int = 5) -> dict:
         "stage2_rows": stage2_rows,
         "residual_uncertified": n_uncert,
         "stride": HEADLINE_STRIDE,
+        "planes": 2,
         "lut_bits": lut_bits,
         "N": N, "Q": Q, "k": K,
     })

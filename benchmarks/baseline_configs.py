@@ -60,7 +60,7 @@ def config1() -> dict:
     sorted_ids, perm, n_valid = jax.block_until_ready(
         sort_table(jnp.asarray(table)))
     lut = build_prefix_lut(sorted_ids, n_valid)
-    expanded = expand_table(sorted_ids)
+    expanded = expand_table(sorted_ids, limbs=2)     # 2-plane fast2 (r5)
 
     def body(q, sorted_ids, expanded, n_valid, lut):
         # fast2 + LUT-only positioning: the get() contract returns node
@@ -68,7 +68,8 @@ def config1() -> dict:
         # measured 27.9M vs 8.5M lookups/s for fast3 with the bounded
         # search at this size
         d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
-                                  select="fast2", lut=lut, lut_steps=0)
+                                  select="fast2", lut=lut, lut_steps=0,
+                                  planes=2)
         return jnp.sum(c.astype(jnp.float32))
 
     # per-rep work is ~40 µs at this size: use deep rep counts so the
@@ -78,7 +79,7 @@ def config1() -> dict:
                          n_valid, lut, r1=64, r2=512)
     _, _, cert = jax.block_until_ready(
         expanded_topk(sorted_ids, expanded, n_valid, jnp.asarray(queries),
-                      k=K, select="fast2", lut=lut, lut_steps=0))
+                      k=K, select="fast2", lut=lut, lut_steps=0, planes=2))
     cert_frac = float(np.asarray(cert).mean())
 
     baseline = None
@@ -131,7 +132,8 @@ def config3_tp(Q: int = 0, N: int = 0, limbs: int = 0) -> dict:
     shard_n = padded.shape[0] // mesh.shape["t"]
 
     fn = build_tp_lookup(mesh, shard_n, Q, 8, 3, SEARCH_NODES, 48,
-                         default_lut_bits(shard_n), limbs)
+                         default_lut_bits(shard_n), limbs,
+                         block_bits=default_lut_bits(N))
     sorted_placed = jax.device_put(jnp.asarray(padded),
                                    NamedSharding(mesh, P("t", None)))
     targets_placed = jax.device_put(targets, NamedSharding(mesh, P("q", None)))
@@ -182,11 +184,14 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0,
 
     on_accel = jax.devices()[0].platform != "cpu"
     N = N or (10_000_000 if on_accel else 100_000)
-    Q = Q or (16_384 if on_accel else 1_024)
-    # measured optimum wave width on v5e (chunk sweep at -Q 1000000:
-    # 16384 → 63.2K/s, 131072 → 56.7K/s — smaller waves keep the
-    # while_loop's straggler tail short)
-    chunk = min(Q, chunk or (16_384 if on_accel else 1_024))
+    Q = Q or (65_536 if on_accel else 1_024)
+    # measured optimum wave width on v5e AFTER the round-5 LUT block
+    # bounds removed the per-round positioning search (exp_search_r5
+    # sweep, 10M table: 8K/16K/32K/64K/128K/256K waves = 282/270/401/
+    # 442/421/350 K lookups/s) — with the serial search gone, wider
+    # waves amortize the issue-bound gathers until HBM pressure turns
+    # over past 128K.  Pre-r5 the optimum was 16384.
+    chunk = min(Q, chunk or (65_536 if on_accel else 1_024))
     key = jax.random.PRNGKey(3)
     k1, k2 = jax.random.split(key)
     table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
@@ -369,15 +374,17 @@ def config5() -> dict:
 
         sorted_ids, perm, n_valid = jax.block_until_ready(make_sorted(k1))
         del perm             # unused here; 256 MB off the expansion peak
+        # 2-plane expansion (r5): 1.56 GB instead of 3.9 for 64M ids —
+        # the fast2 sort + clamped certificate never read planes 2-4
         expanded = jax.block_until_ready(
-            expand_table_chunked(sorted_ids, chunks=8))
+            expand_table_chunked(sorted_ids, chunks=8, limbs=2))
         lut = jax.block_until_ready(
             build_prefix_lut(sorted_ids, n_valid, bits=default_lut_bits(N)))
 
         def body(q, sorted_ids, expanded, n_valid, lut):
             d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q,
                                       k=K, select="fast2", lut=lut,
-                                      lut_steps=0)
+                                      lut_steps=0, planes=2)
             return (jnp.sum(c.astype(jnp.float32))
                     + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
 
@@ -385,7 +392,7 @@ def config5() -> dict:
                          r1=4, r2=32)
         _, _, cert = jax.block_until_ready(
             expanded_topk(sorted_ids, expanded, n_valid, queries, k=K,
-                          select="fast2", lut=lut, lut_steps=0))
+                          select="fast2", lut=lut, lut_steps=0, planes=2))
         cert_frac = float(np.asarray(cert).mean())
     else:
         cert_frac = None
@@ -497,7 +504,8 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
     sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
     del table
-    expanded = jax.block_until_ready(expand_table(sorted_ids))
+    # 2-plane expansion (r5): the whole serving path is fast2
+    expanded = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
     lut = jax.block_until_ready(
         build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
     nv = int(jax.device_get(n_valid))
@@ -574,14 +582,14 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
             dslab, new_ids, (jnp.int32(nd0), 0))
         dvalid = jnp.arange(DCAP) < nd_after
         ds, _dp, dnv = sort_table(ds_slab, dvalid)
-        de = expand_table(ds, stride=32)
+        de = expand_table(ds, stride=32, limbs=2)
         dlut = build_prefix_lut(ds, dnv, bits=d_bits)
         # LUT-only positioning on BOTH sides (the sequential probe-gather
         # steps dominate otherwise); fast2 = nodes-not-distances contract
         _dist, enc, cert = churn_lookup_topk(
             sorted_ids, expanded, n_valid, tomb, ds, de, dnv, q,
             lut=lut, d_lut=dlut, k=K, select="fast2",
-            lut_steps=0, d_lut_steps=0)
+            lut_steps=0, d_lut_steps=0, planes=2)
         return (jnp.sum(cert.astype(jnp.float32))
                 + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9)
 
@@ -593,7 +601,8 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     # ---- static comparator: same-shape plain lookup, no churn structures
     def static_body(q, sorted_ids, expanded, lut, n_valid):
         d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
-                                  select="fast2", lut=lut, lut_steps=0)
+                                  select="fast2", lut=lut, lut_steps=0,
+                                  planes=2)
         return (jnp.sum(c.astype(jnp.float32))
                 + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
 
@@ -613,7 +622,7 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
         cat = jnp.concatenate([sorted_ids, dslab], axis=0)
         cval = jnp.concatenate([live, jnp.arange(DCAP) < nd])
         s2, _p2, nv2 = sort_table(cat, cval)
-        e2 = expand_table(s2)
+        e2 = expand_table(s2, limbs=2)          # the serving form (fast2)
         l2 = build_prefix_lut(s2, nv2, bits=lut_bits)
         return (s2[0, 0].astype(jnp.float32) + e2[0, 0].astype(jnp.float32)
                 + l2[0].astype(jnp.float32) + nv2.astype(jnp.float32))
@@ -629,15 +638,19 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     dvalid = np.zeros(DCAP, bool)
     dvalid[:n_delta] = True
     ds, _dp, dnv = sort_table(jnp.asarray(delta_np), jnp.asarray(dvalid))
-    de = expand_table(ds, stride=32)
+    de = expand_table(ds, stride=32, limbs=2)
     dlut = build_prefix_lut(ds, dnv, bits=d_bits)
+    # fast3 oracle needs full limb planes — built transiently here only
+    exp5 = expand_table(sorted_ids)
+    de5 = expand_table(ds, stride=32)
     dist_c, enc_c, _ = churn_lookup_topk(
-        sorted_ids, expanded, n_valid, jnp.asarray(tomb_np), ds, de, dnv,
+        sorted_ids, exp5, n_valid, jnp.asarray(tomb_np), ds, de5, dnv,
         qs, lut=lut, d_lut=dlut, k=K, select="fast3")
+    del exp5, de5
     _n, enc_f2, _ = churn_lookup_topk(
         sorted_ids, expanded, n_valid, jnp.asarray(tomb_np), ds, de, dnv,
         qs, lut=lut, d_lut=dlut, k=K, select="fast2",
-        lut_steps=0, d_lut_steps=0)
+        lut_steps=0, d_lut_steps=0, planes=2)
     cat = jnp.concatenate([sorted_ids, ds], axis=0)
     cval = jnp.concatenate([jnp.asarray(live_np),
                             jnp.arange(DCAP) < dnv])
@@ -660,11 +673,31 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
                          compact_dt * 1e3, exact, static,
                          churny / static, muts),
             "value": round(churny, 1), "unit": "lookups/s/chip",
+            "mutations_per_s": round(muts, 1),
+            "exact_vs_oracle": exact,
             "vs_baseline": round(churny / static, 4)}
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6}
+
+
+def save_capture(name: str, out: dict) -> None:
+    """Persist a config result as ``captures/<name>.json`` (accelerator
+    runs only — CPU smoke numbers are not quotable).  README/PARITY
+    quote these files and ci/check_docs.py enforces agreement — no
+    hand-typed perf number in the docs (round-4 verdict ask #4)."""
+    import jax
+    if jax.devices()[0].platform == "cpu":
+        return
+    d = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "captures")
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, name + ".json"), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
 
 
 def main(argv=None) -> int:
@@ -697,16 +730,36 @@ def main(argv=None) -> int:
     todo = [args.config] if args.config else sorted(CONFIGS)
     for c in todo:
         if c == 3 and args.tp:
-            print(json.dumps(config3_tp(Q=args.Q, N=args.N,
-                                        limbs=args.limbs)))
+            out = config3_tp(Q=args.Q, N=args.N, limbs=args.limbs)
+            name = "config3_tp"
+            if args.Q or args.N or args.limbs:
+                name += "_custom"        # exploration shape, not quotable
+            save_capture(name, out)
+            print(json.dumps(out))
             continue
         kw = {}
+        name = "config%d" % c
         if c == 3:
             kw = {"Q": args.Q, "N": args.N, "chunk": args.chunk,
                   "limbs": args.limbs, "latency": args.latency}
+            if args.Q >= 1_000_000:
+                name = "config3_star"        # the north-star shape
+            if args.latency:
+                name += "_latency"
         elif c == 6:
             kw = {"churn": args.churn, "dcap": args.dcap}
-        print(json.dumps(CONFIGS[c](**kw)))
+        out = CONFIGS[c](**kw)
+        # non-default shapes (exploration runs) must not overwrite the
+        # quotable artifact for the canonical shape.  Canonical config3
+        # shapes are Q unset (default burst) and Q=1M exactly (the
+        # north star), both at the default chunk; ANY N/chunk/limbs
+        # override or any other Q is exploration.
+        custom3 = bool(args.N or args.limbs or args.chunk
+                       or args.Q not in (0, 1_000_000))
+        if (c == 3 and custom3) or (c == 6 and (args.churn or args.dcap)):
+            name += "_custom"
+        save_capture(name, out)
+        print(json.dumps(out))
     return 0
 
 

@@ -1,0 +1,113 @@
+"""Round-5 headline-geometry experiments (ask 2 follow-up).
+
+With the 2-plane expansion landed (17.9M → 21.5M), the in-window sort
+is the next dominant term.  Measures, per stride (16/24/32):
+stage-1 certification fraction, plain fast2 slope, cascade slope with a
+cap sized to the measured miss count; plus isolated sort and row-gather
+stage costs.  Exploration tool — winners land in bench.py with numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from bench import chain_slope, K
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits, expand_table,
+                                              expanded_topk, cascade_topk)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = 1_000_000 if on_accel else 100_000
+    Q = 131_072 if on_accel else 8_192
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    e64 = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
+
+    def report(stage, dt, extra=None):
+        rec = {"stage": stage, "ms": round(dt * 1e3, 3),
+               "lookups_per_s": round(Q / dt, 1)}
+        if extra:
+            rec.update(extra)
+        print(json.dumps(rec), flush=True)
+
+    # isolated sort cost vs padded lane count (the dominant term):
+    # [Q, wlen] 3-operand num_keys=3 vs num_keys=2-stable
+    for wlen in (48, 96):
+        d0 = jax.random.bits(jax.random.PRNGKey(1), (Q, wlen),
+                             dtype=jnp.uint32)
+        d1 = jax.random.bits(jax.random.PRNGKey(2), (Q, wlen),
+                             dtype=jnp.uint32)
+        gr = jnp.broadcast_to(jnp.arange(wlen, dtype=jnp.int32)[None, :],
+                              (Q, wlen))
+
+        def s3(q, d0, d1, gr):
+            o = lax.sort((d0 ^ q[:, :1], d1, gr), dimension=1, num_keys=3)
+            return jnp.sum(o[2][:, :K].astype(jnp.float32))
+
+        def s2(q, d0, d1, gr):
+            o = lax.sort((d0 ^ q[:, :1], d1, gr), dimension=1, num_keys=2,
+                         is_stable=True)
+            return jnp.sum(o[2][:, :K].astype(jnp.float32))
+
+        for name, body in (("sort3", s3), ("sort2stable", s2)):
+            dt = chain_slope(body, queries, d0, d1, gr, r1=8, r2=64)
+            report(f"{name} wlen={wlen}", dt)
+
+    for stride in (16, 24, 32):
+        e2 = jax.block_until_ready(
+            expand_table(sorted_ids, stride=stride, limbs=2))
+        _, _, c1 = jax.block_until_ready(
+            expanded_topk(sorted_ids, e2, n_valid, queries, k=K,
+                          select="fast2", lut=lut, lut_steps=0, planes=2))
+        miss = int((~np.asarray(c1)).sum())
+
+        def f2(q, sorted_ids, e2, n_valid, lut):
+            d, i, c = expanded_topk(sorted_ids, e2, n_valid, q, k=K,
+                                    select="fast2", lut=lut, lut_steps=0,
+                                    planes=2)
+            return (jnp.sum(c.astype(jnp.float32))
+                    + jnp.sum(i[:, 0].astype(jnp.float32)) * 1e-9)
+
+        dt = chain_slope(f2, queries, sorted_ids, e2, n_valid, lut,
+                         r1=8, r2=64)
+        report(f"fast2 s={stride} planes=2", dt,
+               {"stage1_miss": miss, "cert": 1 - miss / Q})
+
+        cap = 256
+        while cap < 3 * miss and cap < Q:
+            cap *= 2
+
+        def casc(q, sorted_ids, e2, e64, n_valid, lut):
+            d, i, c = cascade_topk(sorted_ids, e2, e64, n_valid, q, lut,
+                                   k=K, select="fast2", cap=cap, planes=2)
+            return (jnp.sum(c.astype(jnp.float32))
+                    + jnp.sum(i[:, 0].astype(jnp.float32)) * 1e-9)
+
+        dt = chain_slope(casc, queries, sorted_ids, e2, e64, n_valid, lut,
+                         r1=8, r2=64)
+        _, _, cc = jax.block_until_ready(
+            cascade_topk(sorted_ids, e2, e64, n_valid, queries, lut,
+                         k=K, select="fast2", cap=cap, planes=2))
+        report(f"cascade s={stride} cap={cap} planes=2", dt,
+               {"residual_uncert": int((~np.asarray(cc)).sum())})
+        del e2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

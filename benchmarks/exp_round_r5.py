@@ -1,0 +1,193 @@
+"""Round-body cost attribution for the iterative engine (ask 1).
+
+The stage-chain profile (profile_search.py) measures pieces in
+isolation, where XLA's loop-invariant hoisting can elide work it cannot
+elide inside the real wave; the numbers did not reconcile with the
+measured wave.  Here each variant runs the REAL round body in a
+fixed-trip ``fori_loop`` (10 rounds, no convergence exit) with one
+piece disabled, so (full − variant) attributes cost inside the real
+compiled loop, fusion effects included.  Exploration tool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from bench import chain_slope
+    from opendht_tpu.ops.ids import N_LIMBS
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits)
+    from opendht_tpu.core import search as SE
+
+    _U32 = jnp.uint32
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = 10_000_000 if on_accel else 100_000
+    W = 16_384 if on_accel else 1_024
+    NL, ALPHA, S, K = 2, 3, 14, 8
+    R = ALPHA * K
+    ROUNDS = 10
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    targets0 = jax.random.bits(k2, (W, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+    n = jnp.asarray(n_valid, jnp.int32)
+
+    def make_wave(variant):
+        def wave(targets, sorted_ids, lut):
+            lower = SE._guarded_lower_bound(sorted_ids, n, lut)
+            sorted_t = sorted_ids.T
+
+            def gather_planar(rows, limbs=N_LIMBS):
+                cl = jnp.clip(rows, 0, N - 1).reshape(-1)
+                g = jnp.take(sorted_t[:limbs], cl, axis=1)
+                return [g[l].reshape(rows.shape) for l in range(limbs)]
+
+            Q = targets.shape[0]
+            seed_u = _U32(1)
+            q_index = jnp.arange(Q, dtype=jnp.int32)
+            pos_t_full = lower(targets)
+
+            def reply_gather(tgt, pt, qidx, x_rows, round_no):
+                Wd = tgt.shape[0]
+                if variant == "no_xl_gather":
+                    b = jnp.full((Wd, x_rows.shape[1]), 8, jnp.int32)
+                else:
+                    x_l = gather_planar(x_rows, N_LIMBS)
+                    t_l = [tgt[:, l:l + 1] for l in range(N_LIMBS)]
+                    b = SE._common_bits_planar(x_l, t_l)
+                if variant == "no_block_bounds":
+                    lo = jnp.zeros_like(b)
+                    ub = jnp.full_like(b, 1 << 20)
+                else:
+                    prefix_len = jnp.clip(b + 1, 0, SE.ID_BITS)
+                    lo, ub = SE._prefix_block_bounds(
+                        lower, n, tgt[:, None, :].repeat(x_rows.shape[1], 1),
+                        prefix_len)
+                size = jnp.maximum(ub - lo, 0)
+                qi = qidx.astype(_U32)[:, None, None]
+                ai = jnp.arange(x_rows.shape[1], dtype=_U32)[None, :, None]
+                ji = jnp.arange(K, dtype=_U32)[None, None, :]
+                ctr = (((round_no.astype(_U32) * _U32(Q) + qi) * _U32(ALPHA)
+                        + ai) * _U32(K) + ji) ^ seed_u
+                h = SE._mix32(ctr)
+                blk = lo[..., None] + (
+                    h % jnp.maximum(size[..., None], 1).astype(_U32)
+                ).astype(jnp.int32)
+                base = jnp.clip(pt[:, None, None] - R // 2, 0,
+                                jnp.maximum(n - R, 0))
+                fb = jnp.clip(base + (ai * _U32(K) + ji).astype(jnp.int32),
+                              0, jnp.maximum(n - 1, 0))
+                rows = jnp.where((size[..., None] >= K), blk, fb)
+                rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
+                return rows.reshape(Wd, R)
+
+            def merge(tgt, cand_node, cand_l, queried, new_rows):
+                Wd = tgt.shape[0]
+                if variant == "no_reply_gather":
+                    new_l = [jnp.zeros((Wd, R), _U32) for _ in range(NL)]
+                else:
+                    new_l = gather_planar(new_rows, NL)
+                node = jnp.concatenate([cand_node, new_rows], axis=1)
+                d_l = [jnp.concatenate(
+                    [cand_l[l], new_l[l] ^ tgt[:, l:l + 1]], axis=1)
+                    for l in range(NL)]
+                qd = jnp.concatenate([queried,
+                                      jnp.zeros((Wd, R), jnp.int32)], axis=1)
+                inv = (node < 0).astype(jnp.int32)
+                big = jnp.uint32(0xFFFFFFFF)
+                d_l = [jnp.where(inv == 0, dl, big) for dl in d_l]
+                out = lax.sort((inv,) + tuple(d_l) + (node, 1 - qd),
+                               dimension=1, num_keys=3 + NL)
+                inv_s, node_s = out[0], out[1 + NL]
+                qd_s = 1 - out[2 + NL]
+                if variant == "no_dedup_sort":
+                    present = inv_s[:, :S] == 0
+                    node_f = jnp.where(present, node_s[:, :S], -1)
+                    d_f = [jnp.where(present, out[1 + l][:, :S], big)
+                           for l in range(NL)]
+                    qd_f = qd_s[:, :S] * present
+                    return node_f, d_f, qd_f
+                dup = jnp.concatenate(
+                    [jnp.zeros((Wd, 1), bool),
+                     (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)],
+                    axis=1)
+                inv2 = jnp.where(dup, 1, inv_s)
+                out2 = lax.sort(
+                    (inv2,) + tuple(out[1:1 + NL]) + (node_s, 1 - qd_s),
+                    dimension=1, num_keys=2 + NL)
+                present = out2[0][:, :S] == 0
+                node_f = jnp.where(present, out2[1 + NL][:, :S], -1)
+                d_f = [jnp.where(present, out2[1 + l][:, :S], big)
+                       for l in range(NL)]
+                qd_f = (1 - out2[2 + NL])[:, :S] * present
+                return node_f, d_f, qd_f
+
+            boot = jnp.full((Q, ALPHA), -1, jnp.int32).at[:, 0].set(
+                (SE._mix32(q_index.astype(_U32) ^ seed_u)
+                 % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32))
+            cand_node = jnp.full((Q, S), -1, jnp.int32)
+            cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(NL)]
+            queried = jnp.zeros((Q, S), jnp.int32)
+            first = reply_gather(targets, pos_t_full, q_index, boot,
+                                 jnp.int32(0))
+            cand_node, cand_l, queried = merge(targets, cand_node, cand_l,
+                                               queried, first)
+
+            def body(rnd, state):
+                cand_node, cand_l, queried = state
+                can = (cand_node >= 0) & (queried == 0)
+                rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+                sel = can & (rank <= ALPHA)
+                if variant == "no_alpha_select":
+                    x_rows = cand_node[:, :ALPHA]
+                else:
+                    x_rows = jnp.stack(
+                        [jnp.max(jnp.where(sel & (rank == j + 1),
+                                           cand_node, -1), axis=1)
+                         for j in range(ALPHA)], axis=1)
+                new_rows = reply_gather(targets, pos_t_full, q_index,
+                                        x_rows, rnd + 1)
+                queried = jnp.where(sel, 1, queried)
+                cand_node, cand_l, queried = merge(
+                    targets, cand_node, cand_l, queried, new_rows)
+                return cand_node, cand_l, queried
+
+            cand_node, cand_l, queried = lax.fori_loop(
+                0, ROUNDS, body, (cand_node, cand_l, queried))
+            return (jnp.sum(cand_node[:, :K].astype(jnp.float32)) * 1e-9
+                    + jnp.sum(queried.astype(jnp.float32)) * 1e-9)
+        return wave
+
+    variants = ["full", "no_dedup_sort", "no_reply_gather",
+                "no_block_bounds", "no_xl_gather", "no_alpha_select"]
+    base = None
+    for v in variants:
+        dt = chain_slope(make_wave(v), targets0, sorted_ids, lut,
+                         r1=1, r2=4)
+        rec = {"variant": v, "ms": round(dt * 1e3, 2),
+               "ms_per_round": round(dt * 1e3 / ROUNDS, 3)}
+        if v == "full":
+            base = dt
+        elif base:
+            rec["saves_ms"] = round((base - dt) * 1e3, 2)
+        print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

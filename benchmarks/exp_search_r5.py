@@ -1,0 +1,89 @@
+"""Round-5 experiment driver for the iterative engine (ask 1).
+
+Measures, on the real chip, the levers the round-4 verdict names:
+round-count distribution, wave-width sweep, survivor-compaction cuts.
+Temporary exploration tool; the winning configuration lands in
+core/search.py + baseline_configs.py with its numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-N", type=int, default=0)
+    p.add_argument("--widths", type=str, default="8192,16384,32768")
+    p.add_argument("--cuts", type=str, default="0,8,10")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from bench import chain_slope
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits)
+    from opendht_tpu.core import search as SE
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = args.N or (10_000_000 if on_accel else 100_000)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+
+    # round-count distribution at W=16384 (hops ≈ rounds for converged)
+    W0 = 16_384 if on_accel else 1_024
+    tg = jax.random.bits(k2, (W0, 5), dtype=jnp.uint32)
+    out = jax.block_until_ready(SE.simulate_lookups(
+        sorted_ids, n_valid, tg, alpha=3, k=8, lut=lut, state_limbs=2))
+    hops = np.asarray(out["hops"])
+    print(json.dumps({
+        "stage": "hops dist W=%d" % W0,
+        "p50": int(np.percentile(hops, 50)),
+        "p90": int(np.percentile(hops, 90)),
+        "p99": int(np.percentile(hops, 99)),
+        "max": int(hops.max()),
+        "mean": round(float(hops.mean()), 2),
+        "converged": float(np.asarray(out["converged"]).mean()),
+    }), flush=True)
+
+    def make_body(compact_after, compact_cap):
+        def body(t, sorted_ids, n_valid, lut):
+            o = SE.simulate_lookups(sorted_ids, n_valid, t, alpha=3, k=8,
+                                    lut=lut, state_limbs=2,
+                                    compact_after=compact_after,
+                                    compact_cap=compact_cap)
+            return (jnp.sum(o["hops"].astype(jnp.float32))
+                    + jnp.sum(o["converged"].astype(jnp.float32)))
+        return body
+
+    widths = [int(w) for w in args.widths.split(",") if w]
+    cuts = [int(c) for c in args.cuts.split(",") if c != ""]
+    for W in widths:
+        t = jax.random.bits(jax.random.PRNGKey(100 + W), (W, 5),
+                            dtype=jnp.uint32)
+        for cut in cuts:
+            ca = None if cut == 0 else cut
+            cc = 0 if cut == 0 else max(256, W // 8)
+            dt = chain_slope(make_body(ca, cc), t, sorted_ids, n_valid, lut,
+                             r1=1, r2=4)
+            print(json.dumps({
+                "stage": "wave W=%d cut=%s cap=%d" % (W, ca, cc),
+                "ms": round(dt * 1e3, 2),
+                "lookups_per_s": round(W / dt, 1),
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

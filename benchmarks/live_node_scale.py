@@ -1,0 +1,200 @@
+"""Live protocol node at DEVICE scale (round-4 verdict ask #3).
+
+One real ``Dht`` node, bulk-loaded with an N-row table (default 1M —
+far past the ``HOST_SCAN_MAX_ROWS`` host-scan threshold,
+core/table.py:62), serving a concurrent burst of ``find``/``get``
+requests over real localhost UDP from a client engine.  Every reply's
+closest-node set is resolved through the full stack:
+
+    UDP → NetworkEngine.process_message → Dht._on_find_node/_on_get_values
+        → NodeTable.find_closest → Snapshot/ChurnView.lookup (DEVICE)
+
+The run asserts the device path was actually taken (table size over the
+host-scan threshold, a built snapshot whose version matches the table,
+and a device-lookup call count equal to the burst), then reports
+end-to-end served requests/s — the number quoted in README
+(<!-- capture:live_node -->).  ``--batched`` additionally measures the
+server-side batched resolve path (``find_closest_nodes_batched``) that
+a wave of concurrent lookups shares in one device call.
+
+Usage::  python benchmarks/live_node_scale.py [-N 1000000] [-Q 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+import select
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-N", type=int, default=0, help="table rows")
+    p.add_argument("-Q", type=int, default=512, help="burst size")
+    p.add_argument("--batched", action="store_true", default=True)
+    args = p.parse_args(argv)
+
+    import jax
+    from opendht_tpu.core import table as table_mod
+    from opendht_tpu.core.value import Query
+    from opendht_tpu.infohash import InfoHash
+    from opendht_tpu.net.engine import EngineCallbacks, NetworkEngine
+    from opendht_tpu.runtime.config import Config
+    from opendht_tpu.runtime.dht import Dht
+    from opendht_tpu.scheduler import Scheduler
+    from opendht_tpu.sockaddr import SockAddr
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = args.N or (1_000_000 if on_accel else 100_000)
+    Q = args.Q
+
+    # ---- server: a real Dht node over a real UDP socket ----------------
+    ssock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ssock.bind(("127.0.0.1", 0))
+    sport = ssock.getsockname()[1]
+    ssock.setblocking(False)
+
+    dht = Dht(lambda data, dst: ssock.sendto(data, (str(dst.ip), dst.port))
+              and 0,
+              Config(max_req_per_sec=1_000_000), has_v6=False)
+    table = dht.tables[socket.AF_INET]
+
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 2 ** 32, size=(N, 5), dtype=np.uint32)
+    t0 = time.perf_counter()
+    table.bulk_load(ids, dht.scheduler.time(),
+                    addrs=SockAddr("10.1.2.3", 4567))
+    load_dt = time.perf_counter() - t0
+    dht.warmup()                      # compile + build the device snapshot
+    snap0 = table._snap
+    assert snap0 is not None and len(table) > table_mod.HOST_SCAN_MAX_ROWS
+
+    # count every device lookup through the snapshot/churn view
+    lookups = {"n": 0, "q": 0}
+    for cls in (table_mod.Snapshot, table_mod.ChurnView):
+        orig = cls.lookup
+
+        def counted(self, queries, *, _orig=orig, **kw):
+            lookups["n"] += 1
+            lookups["q"] += int(np.asarray(queries).shape[0])
+            return _orig(self, queries, **kw)
+
+        cls.lookup = counted
+
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            r, _, _ = select.select([ssock], [], [], 0.02)
+            if not r:
+                dht.periodic(None, None)
+                continue
+            try:
+                data, addr = ssock.recvfrom(64 * 1024)
+            except OSError:
+                continue
+            dht.periodic(data, SockAddr(addr[0], addr[1]))
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+
+    # ---- client: raw engine bursting find + get requests ---------------
+    csock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    csock.bind(("127.0.0.1", 0))
+    csock.setblocking(False)
+    ceng = NetworkEngine(InfoHash.get("live-scale-client"), 0,
+                         lambda data, dst: csock.sendto(
+                             data, (str(dst.ip), dst.port)) and 0,
+                         Scheduler(), EngineCallbacks())
+    peer = SockAddr("127.0.0.1", sport)
+    node = ceng.cache.get_node(dht.myid, peer, time.monotonic(),
+                               confirm=True)
+
+    done = []
+    lookups["n"] = lookups["q"] = 0
+    t0 = time.perf_counter()
+    for i in range(Q):
+        tgt = InfoHash.get(b"burst-" + secrets.token_bytes(8))
+        if i % 2:
+            ceng.send_find_node(node, tgt, want=1,
+                                on_done=lambda r, a: done.append(a))
+        else:
+            ceng.send_get_values(node, tgt, Query(), want=1,
+                                 on_done=lambda r, a: done.append(a))
+    # CPU-backend per-dispatch overhead is ~0.2 s/request; the tunneled
+    # TPU round-trip tens of ms — budget generously, the measure is the
+    # achieved rate, not the deadline
+    deadline = time.monotonic() + max(30.0, Q * (0.3 if on_accel else 1.2))
+    while len(done) < Q and time.monotonic() < deadline:
+        ceng.scheduler.run()
+        r, _, _ = select.select([csock], [], [], 0.02)
+        if r:
+            try:
+                data, addr = csock.recvfrom(64 * 1024)
+            except OSError:
+                continue
+            ceng.process_message(data, SockAddr(addr[0], addr[1]))
+    dt = time.perf_counter() - t0
+    stop.set()
+    th.join()
+
+    n_nodes = sum(len(a.nodes4) for a in done)
+    dev_calls, dev_q = lookups["n"], lookups["q"]
+    ok_device = (dev_calls >= len(done)
+                 and table._snap is not None
+                 and table._snap.version == table._version)
+
+    out = {
+        "metric": "live node, %d-row table over real UDP: %d/%d "
+                  "find+get requests served end-to-end (device lookups: "
+                  "%d calls / %d queries; snapshot v%d == table v%d; "
+                  "host-scan threshold %d; bulk load %.1fs)"
+                  % (len(table), len(done), Q, dev_calls, dev_q,
+                     table._snap.version, table._version,
+                     table_mod.HOST_SCAN_MAX_ROWS, load_dt),
+        "value": round(len(done) / dt, 1),
+        "unit": "requests/s",
+        "device_path": bool(ok_device),
+        "replies_with_nodes": n_nodes,
+        "vs_baseline": None,
+    }
+    print(json.dumps(out), flush=True)
+
+    if args.batched and len(done) == Q:
+        # server-side batched resolve: one device call for a whole wave
+        targets = [InfoHash.get(b"wave-%d" % i) for i in range(4096)]
+        t0 = time.perf_counter()
+        res = dht.find_closest_nodes_batched(targets, socket.AF_INET)
+        bdt = time.perf_counter() - t0
+        out2 = {
+            "metric": "live node batched resolve: 4096 targets through "
+                      "Dht.find_closest_nodes_batched in one device call "
+                      "(%d-row table)" % len(table),
+            "value": round(len(targets) / bdt, 1),
+            "unit": "lookups/s",
+            "all_answered": all(len(r) == 8 for r in res),
+            "vs_baseline": None,
+        }
+        print(json.dumps(out2), flush=True)
+        try:
+            from benchmarks.baseline_configs import save_capture
+            cap = dict(out)
+            cap["batched_lookups_per_s"] = out2["value"]
+            save_capture("live_node", cap)
+        except Exception:
+            pass
+    return 0 if len(done) == Q and ok_device else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,7 +154,7 @@ def main(argv=None) -> int:
                         dimension=1, num_keys=2 + NL)
         return jnp.sum(out2[1 + NL][:, :S].astype(jnp.float32)) * 1e-9
     stage("s4 merge sorts (2x [W,%d])" % (S + R), s4, cand_node, queried,
-          new_rows, *cand_l)
+          new_rows, sorted_ids, lut, *cand_l)
 
     # s5: candidate alpha-selection (masked max-reductions); cn is
     # perturbed by q for the same anti-elision reason as s3

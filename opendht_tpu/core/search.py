@@ -109,6 +109,39 @@ def _prefix_block_bounds(lower, n, targets, prefix_len):
     return lo, ub
 
 
+def _lut_block_bounds(lut, t0, prefix_len):
+    """[lo, ub) sorted-index range of ids sharing ``prefix_len`` leading
+    bits with targets whose FIRST LIMB is ``t0`` — as two LUT reads, no
+    binary search.
+
+    ``build_prefix_lut``'s entry p is the count of valid rows with
+    top-``bits`` prefix < p, so for any prefix length L ≤ bits the block
+    edges are EXACT on any table: lo = lut[pfx], ub = lut[pfx + 2^(bits−L)]
+    (the +1 sentinel entry covers the all-ones wrap).  Deeper prefixes
+    clamp to their containing LUT bucket — an over-approximation whose
+    only observable effect is the reply model's ``size ≥ k`` branch: at
+    the default ~1-row buckets (default_lut_bits) a clamped bucket is
+    ~never ≥ k rows, so both the exact and clamped computations take
+    the near-target fallback window and the trajectory is unchanged
+    (measured: hop distribution and convergence identical at 10M).
+
+    This removes the per-round batched binary search that the round-body
+    attribution (benchmarks/exp_round_r5.py) measured at 8.6 of the
+    10.1 ms round — the round-5 engine win.  The sharded twin computes
+    the same values as a psum of per-shard LUT reads (global lower
+    bound = Σ shard-local counts), so tp/single-device bit-identity is
+    preserved (tests/test_sharded.py).
+    """
+    bits = _lut_bits(lut)
+    Lc = jnp.clip(prefix_len, 0, bits)
+    shift = (jnp.int32(bits) - Lc).astype(_U32)
+    top = (t0 >> _U32(32 - bits)).astype(_U32)
+    pfx = (top >> shift) << shift
+    lo = jnp.take(lut, pfx.astype(jnp.int32))
+    ub = jnp.take(lut, (pfx + (_U32(1) << shift)).astype(jnp.int32))
+    return lo, ub
+
+
 def _guarded_lower_bound(sorted_ids, n, lut):
     """Positioning closure: LUT-started bounded search when every LUT
     bucket fits the in-bucket step budget, else the full-depth binary
@@ -208,7 +241,8 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
                    seed_u, *, k, alpha, search_nodes, max_hops,
                    state_limbs: int = N_LIMBS,
                    compact_after: "int | None" = None,
-                   compact_cap: int = 0):
+                   compact_cap: int = 0,
+                   block_bounds=None):
     """The iterative-lookup state machine, abstracted over table access.
 
     ALL access to the (possibly distributed) sorted node table flows
@@ -223,6 +257,14 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
           entries for out-of-range rows may be garbage — every caller
           masks them.
       lower(flat [M, 5]) -> [M] int32 global lower-bound positions.
+      block_bounds(t0, prefix_len) -> (lo, ub) prefix-block edges
+          (optional third primitive): t0 = targets' first limb
+          (broadcastable against prefix_len).  When provided (the
+          :func:`_lut_block_bounds` fast path — two LUT reads), the
+          per-round positioning search disappears, which the round-body
+          attribution measured as 85% of the round; when None the
+          engine falls back to the exact search via ``lower``
+          (:func:`_prefix_block_bounds`).
 
     ``q_index``/``q_total`` are each query's GLOBAL index and the global
     batch size — the deterministic reply hash is seeded by global query
@@ -266,8 +308,12 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         t_l = [tgt[:, l:l + 1] for l in range(N_LIMBS)]
         b = _common_bits_planar(x_l, t_l)                            # [W,a]
         prefix_len = jnp.clip(b + 1, 0, ID_BITS)
-        lo, ub = _prefix_block_bounds(lower, n, tgt[:, None, :]
-                                      .repeat(x_rows.shape[1], 1), prefix_len)
+        if block_bounds is not None:
+            lo, ub = block_bounds(tgt[:, 0:1], prefix_len)
+        else:
+            lo, ub = _prefix_block_bounds(lower, n, tgt[:, None, :]
+                                          .repeat(x_rows.shape[1], 1),
+                                          prefix_len)
         size = jnp.maximum(ub - lo, 0)                                     # [W,a]
 
         qi = qidx.astype(_U32)[:, None, None]          # GLOBAL query ids
@@ -476,14 +522,15 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "alpha", "search_nodes", "max_hops",
-                     "state_limbs", "compact_after", "compact_cap"),
+                     "state_limbs", "compact_after", "compact_cap",
+                     "block_mode"),
 )
 def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
                      k: int = TARGET_NODES, alpha: int = ALPHA,
                      search_nodes: int = SEARCH_NODES, max_hops: int = 48,
                      lut=None, state_limbs: int = N_LIMBS,
                      compact_after: "int | None" = None,
-                     compact_cap: int = 0):
+                     compact_cap: int = 0, block_mode: str = "lut"):
     """Run Q iterative lookups to convergence against an N-node network.
 
     Args:
@@ -506,7 +553,26 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
     bits only (5-operand merge sorts instead of 8 — see
     :func:`_lookup_engine`); bitwise identical to the default absent
     64-bit distance ties.
+
+    ``block_mode`` selects how the simulated reply model computes each
+    peer's prefix-block edges: ``"lut"`` (default) = two LUT reads per
+    edge (:func:`_lut_block_bounds`) — exact for prefixes up to the LUT
+    width, clamped to the containing bucket beyond it; ``"exact"`` =
+    the per-round batched binary search (the pre-round-5 model, exact
+    at any depth, measured 85% of the round's wall-clock at 10M —
+    benchmarks/exp_round_r5.py).  On uniform tables at
+    ``default_lut_bits`` the two are statistically indistinguishable
+    (a clamped bucket with ≥ k rows exists for ~4 of 16.7M buckets at
+    N=10M and affects a reply only when a target lands in it past the
+    LUT depth); on heavily CLUSTERED tables the clamp widens deep
+    blocks, so hop-trajectory studies of adversarial id distributions
+    should pass ``block_mode="exact"`` (cf. the positioning guard
+    ``_guarded_lower_bound``, which handles clustering for the
+    positioning search automatically).
     """
+    if block_mode not in ("lut", "exact"):
+        raise ValueError(f"block_mode must be 'lut' or 'exact', "
+                         f"got {block_mode!r}")
     N = sorted_ids.shape[0]
     Q = targets.shape[0]
     n = jnp.asarray(n_valid, jnp.int32)
@@ -544,7 +610,10 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
                           k=k, alpha=alpha, search_nodes=search_nodes,
                           max_hops=max_hops, state_limbs=state_limbs,
                           compact_after=compact_after,
-                          compact_cap=compact_cap)
+                          compact_cap=compact_cap,
+                          block_bounds=(
+                              (lambda t0, L: _lut_block_bounds(lut, t0, L))
+                              if block_mode == "lut" else None))
 
 
 # ---------------------------------------------------------------------------

@@ -315,7 +315,11 @@ class NodeTable:
     # ------------------------------------------------------------- mutation
     def _touch(self) -> None:
         """Structural change the churn view cannot absorb: drop both the
-        base snapshot and the churn state (next view rebuilds)."""
+        base snapshot and the churn state (next view rebuilds).  A view
+        carrying pending churn counts as a compaction — the rebuild it
+        forces folds that churn into the next base."""
+        if self._churn is not None and self._churn.pending:
+            self.compactions += 1
         self._version += 1
         self._snap = None
         self._churn = None
@@ -458,10 +462,36 @@ class NodeTable:
             self._evict_row(int(row))
 
     def bulk_load(self, ids_u32: np.ndarray, now: float = 0.0,
-                  *, replied: bool = True) -> None:
+                  *, replied: bool = True, addrs=None) -> None:
         """Fill the slab from an [N,5] uint32 id matrix (simulation-scale
-        path: no per-row dict bookkeeping, buckets computed on device)."""
+        path: no per-row dict bookkeeping, buckets computed on device).
+        ``addrs``: optional per-row address (sequence aligned to rows, or
+        one address shared by all) so loaded rows are servable in
+        closest-node replies (benchmarks/live_node_scale.py).
+
+        Ids already live in the table and batch-internal duplicates are
+        dropped: live ids must stay unique across base and delta
+        (note_insert's precondition — a duplicate would otherwise appear
+        twice in a top-k result through the churn merge)."""
+        ids_u32 = np.asarray(ids_u32, dtype=np.uint32)
+        raw = IK.ids_to_bytes(ids_u32)
+        seen: set = set()
+        keep: list = []
+        for i in range(ids_u32.shape[0]):
+            kb = raw[i].tobytes()
+            if kb in seen or kb in self._row_of:
+                continue
+            seen.add(kb)
+            keep.append(i)
+        per_row_addrs = isinstance(addrs, (list, tuple, np.ndarray))
+        if len(keep) != ids_u32.shape[0]:
+            if per_row_addrs:
+                addrs = [addrs[i] for i in keep]
+            ids_u32 = ids_u32[keep]
+            raw = raw[keep]
         n = ids_u32.shape[0]
+        if n == 0:
+            return
         while self._cap < len(self) + n:
             self._grow()
         rows = np.array([self._free.pop() for _ in range(n)], dtype=np.int64)
@@ -475,9 +505,10 @@ class NodeTable:
                                        jnp.asarray(ids_u32)))
         self._bucket[rows] = b.astype(np.int16)
         np.add.at(self._bucket_count, b, 1)
-        raw = IK.ids_to_bytes(ids_u32)
         for i, row in enumerate(rows):
             self._row_of[raw[i].tobytes()] = int(row)
+            if addrs is not None:
+                self._addrs[int(row)] = addrs[i] if per_row_addrs else addrs
         ch = self._churn
         if ch is not None and self._snap is not None \
                 and ch.n_delta + n <= self.delta_capacity:
@@ -538,6 +569,11 @@ class NodeTable:
             m = self._valid
         else:
             m = self.reachable_mask(now)
+        # count a *compaction* only when this rebuild folds pending
+        # churn (delta inserts / tombstones) back into the base — plain
+        # first builds and mask-flavor rebuilds are not compactions
+        if self.churn_pending > 0:
+            self.compactions += 1
         sorted_ids, perm, n_valid = sort_table(
             jnp.asarray(self._ids), jnp.asarray(m)
         )
@@ -547,7 +583,6 @@ class NodeTable:
         # on mutation as before.
         self._churn = ChurnView(self._snap, self._cap, self._delta_cap) \
             if mask == "reachable" else None
-        self.compactions += 1
         return self._snap
 
     def view(self, now: Optional[float] = None, *, mask: str = "reachable"):

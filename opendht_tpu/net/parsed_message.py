@@ -53,8 +53,12 @@ REQUEST_TYPES = frozenset(_QUERY_TYPES.values())
 
 def unpack_tid(o) -> int:
     """tid arrives as a 4-byte big-endian bin or a plain int
-    (parsed_message.h:29-36)."""
+    (parsed_message.h:29-36).  Out-of-range int tids are rejected here
+    — a hostile 2^63 tid would otherwise crash the engine later when it
+    echoes the tid into a reply header (found by tests/test_wire_fuzz.py)."""
     if isinstance(o, int):
+        if not 0 <= o < 1 << 32:
+            raise ValueError(f"bad tid value {o}")
         return o
     b = bytes(o)
     if len(b) != 4:

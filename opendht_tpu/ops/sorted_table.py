@@ -286,6 +286,21 @@ def window_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     return top_dist, top_idx, certified
 
 
+def _cb_clamped(queries, ids):
+    """Common-prefix bits of ``queries`` [Q,5] vs ``ids`` [Q,L], clamped
+    at 32·L when only the top L limbs are available.  Equal to
+    ops.ids.common_bits for L=5."""
+    L = ids.shape[-1]
+    out = jnp.full(queries.shape[:-1], 32 * L, dtype=jnp.int32)
+    prev_zero = jnp.ones(queries.shape[:-1], dtype=bool)
+    for l in range(L):
+        xi = queries[..., l] ^ ids[..., l]
+        first = prev_zero & (xi != 0)
+        out = jnp.where(first, 32 * l + clz32(xi), out)
+        prev_zero = prev_zero & (xi == 0)
+    return out
+
+
 def _window_certificate(queries, cp_k, kth_valid, left_ids, right_ids,
                         left_exists, right_exists):
     """Exactness certificate shared by the window and expanded lookups.
@@ -297,9 +312,16 @@ def _window_certificate(queries, cp_k, kth_valid, left_ids, right_ids,
     < 2^(160-cp_k); cp_k > cbL makes every window top-k strictly closer
     than every excluded node.  Symmetrically on the right.  ``cp_k`` may
     be a lower bound — that only makes the certificate conservative.
+
+    With 2-limb neighbor ids (the 2-plane fast2 expansion) cbL/cbR clamp
+    at 64; since the fast2 ``cp_k`` is itself clamped at 64, the
+    comparison ``cp_k > cb`` is unchanged: a true cb ≥ 64 denies the
+    certificate either way (cp_k ≤ 64 can never exceed it), and below
+    64 the clamped value is exact — so the 2-plane certificate is
+    bit-identical to the 5-plane fast2 one (tests/test_topk.py).
     """
-    cbL = common_bits(queries, left_ids)
-    cbR = common_bits(queries, right_ids)
+    cbL = _cb_clamped(queries, left_ids)
+    cbR = _cb_clamped(queries, right_ids)
     covers_all = (~left_exists) & (~right_exists)
     ok_left = (~left_exists) | (cp_k > cbL)
     ok_right = (~right_exists) | (cp_k > cbR)
@@ -332,10 +354,20 @@ EXPAND_LEN = 3 * EXPAND_STRIDE          # candidate window rows per entry
 _EROW = EXPAND_LEN + 2                  # + left/right certificate neighbors
 
 
-@functools.partial(jax.jit, static_argnames=("stride",))
-def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE):
-    """[N, 5] sorted ids → [ceil(N/s), 5·(3s+2)] overlapping window rows
-    (s = ``stride``; default 64 → 194-lane planes).
+@functools.partial(jax.jit, static_argnames=("stride", "limbs"))
+def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE,
+                 limbs: int = N_LIMBS):
+    """[N, 5] sorted ids → [ceil(N/s), limbs·(3s+2)] overlapping window
+    rows (s = ``stride``; default 64 → 194-lane planes).
+
+    ``limbs`` < 5 builds only the top limb planes — the **2-plane form**
+    is sufficient for the ``select="fast2"`` lookup (nodes-not-distances
+    contract): the fast2 sort consumes planes 0-1 only, and its
+    exactness certificate clamps the kth result's common prefix at 64
+    bits (:func:`expanded_topk`), so the neighbor-lane comparison needs
+    the same two planes.  That cuts the dominant per-query row-gather
+    traffic by 3/5 and the expansion memory from 3× to 1.2× of the
+    table (the round-4 verdict's ask #2).
 
     Row j holds sorted rows [s·j-1, s·j+3s+1) in **limb-planar** order:
     lanes [l·(3s+2), (l+1)·(3s+2)) are limb l of those 3s+2 rows.
@@ -364,7 +396,7 @@ def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE):
     pad = nblk * stride - N - 1
     padded = jnp.pad(sorted_ids, ((1, pad), (0, 0)))    # padded[i] = sorted[i-1]
     planes = []
-    for l in range(N_LIMBS):
+    for l in range(limbs):
         Bl = padded[:, l].reshape(nblk, stride)
         planes.append(jnp.concatenate(
             [Bl[:NB], Bl[1:NB + 1], Bl[2:NB + 2], Bl[3:NB + 3, :2]], axis=1))
@@ -372,7 +404,7 @@ def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE):
 
 
 def expand_table_chunked(sorted_ids, *, stride: int = EXPAND_STRIDE,
-                         chunks: int = 8):
+                         chunks: int = 8, limbs: int = N_LIMBS):
     """Same window-row table as :func:`expand_table`, built in
     ``chunks`` pieces with a donated in-place row update.
 
@@ -407,18 +439,18 @@ def expand_table_chunked(sorted_ids, *, stride: int = EXPAND_STRIDE,
                         jnp.take(sorted_ids, jnp.clip(idx, 0, N - 1),
                                  axis=0), jnp.uint32(0))
         planes = []
-        for l in range(N_LIMBS):
+        for l in range(limbs):
             Bl = src[:, l].reshape(NBc + 3, stride)
             planes.append(jnp.concatenate(
                 [Bl[:NBc], Bl[1:NBc + 1], Bl[2:NBc + 2], Bl[3:NBc + 3, :2]],
                 axis=1))
-        return jnp.concatenate(planes, axis=1)          # [NBc, 5·erow]
+        return jnp.concatenate(planes, axis=1)          # [NBc, limbs·erow]
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def upd(out, piece, row0):
         return lax.dynamic_update_slice(out, piece, (row0, jnp.int32(0)))
 
-    out = jnp.zeros((chunks * NBc, N_LIMBS * erow), jnp.uint32)
+    out = jnp.zeros((chunks * NBc, limbs * erow), jnp.uint32)
     for c in range(chunks):
         piece = build_piece(sorted_ids, jnp.int32(c * NBc * stride))
         out = upd(out, piece, jnp.int32(c * NBc))
@@ -436,11 +468,19 @@ def unpack_tomb_bits(tomb_bits, n: int):
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps",
-                                             "fast2_limbs"))
+                                             "fast2_limbs", "planes"))
 def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
                   select: str = "auto", lut=None, lut_steps=None,
-                  tomb_bits=None, fast2_limbs: bool = False):
+                  tomb_bits=None, fast2_limbs: bool = False,
+                  planes: int = N_LIMBS):
     """k XOR-closest via the expanded table — one row gather per query.
+
+    ``planes`` declares how many limb planes ``expanded`` carries
+    (``expand_table(..., limbs=planes)``).  ``planes=2`` is valid only
+    with ``select="fast2"`` — the sort and the (clamped) certificate
+    consume planes 0-1 only, so the gathered row shrinks 5→2 planes
+    (the dominant HBM traffic of the headline kernel; results are
+    bit-identical to the 5-plane fast2 path).
 
     ``select``: ``"pallas"`` = fused min-extraction kernel
     (ops/pallas_window_topk.py — exact 5-limb ordering, but measured
@@ -473,8 +513,25 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     """
     if select == "auto":
         select = "fast3"
+    if planes != N_LIMBS and select != "fast2":
+        raise ValueError(f"planes={planes} requires select='fast2' "
+                         f"(got {select!r}) — only the fast2 sort and "
+                         "certificate are sound on partial limb planes")
+    if planes < 2:
+        raise ValueError("planes must be >= 2 (fast2 sorts on d0, d1)")
+    if expanded.shape[1] % planes:
+        # catches the easy mismatch now that 2- and 5-plane expansions
+        # coexist for one table (e.g. a 2-plane stride-64 row is 388
+        # lanes — not divisible by the default planes=5).  The converse
+        # direction can alias arithmetically (490 lanes % 2 == 0), so
+        # the caller contract stands: `planes` MUST match the
+        # expand_table(limbs=) that built `expanded`.
+        raise ValueError(
+            f"expanded width {expanded.shape[1]} is not a multiple of "
+            f"planes={planes} — pass the planes= the expansion was "
+            "built with (expand_table limbs=)")
     NB = expanded.shape[0]
-    erow = expanded.shape[1] // N_LIMBS     # lanes per limb plane = 3s+2
+    erow = expanded.shape[1] // planes      # lanes per limb plane = 3s+2
     wlen = erow - 2                         # candidate window rows = 3s
     stride = wlen // 3
     n_valid = jnp.asarray(n_valid, jnp.int32)
@@ -523,9 +580,9 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
         shifts = jnp.tile(jnp.arange(32, dtype=jnp.uint32), nw)
         tomb = ((jnp.repeat(words, 32, axis=1) >> shifts[None, :]) & 1) != 0
 
-    rows = jnp.take(expanded, j, axis=0)                   # [Q, 5·(3s+2)]
+    rows = jnp.take(expanded, j, axis=0)             # [Q, planes·(3s+2)]
     # limb planes — contiguous lane slices, everything stays 2-D
-    plane = [rows[:, l * erow:(l + 1) * erow] for l in range(N_LIMBS)]
+    plane = [rows[:, l * erow:(l + 1) * erow] for l in range(planes)]
     left_ids = jnp.stack([p[:, 0] for p in plane], axis=-1)
     right_ids = jnp.stack([p[:, erow - 1] for p in plane], axis=-1)
 
@@ -634,9 +691,10 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     return top_dist, top_idx, certified
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select", "cap"))
+@functools.partial(jax.jit, static_argnames=("k", "select", "cap", "planes"))
 def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
-                 k: int = 8, select: str = "fast2", cap: int = 512):
+                 k: int = 8, select: str = "fast2", cap: int = 512,
+                 planes: int = N_LIMBS):
     """Two-stage certified lookup in ONE device call — the headline
     kernel (bench.py).
 
@@ -661,7 +719,8 @@ def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
     with the :func:`expanded_topk` contract.
     """
     d, idx, cert = expanded_topk(sorted_ids, exp_fast, n_valid, queries,
-                                 k=k, select=select, lut=lut, lut_steps=0)
+                                 k=k, select=select, lut=lut, lut_steps=0,
+                                 planes=planes)
     # fill_value=0 pads `bad` with duplicate index 0 when fewer than
     # `cap` rows decertify, so the .at[bad].set scatters below write row
     # 0 repeatedly.  That is deterministic ONLY because every duplicate
@@ -675,7 +734,7 @@ def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
     qb = jnp.take(queries, bad, axis=0)
     # full-depth positioning for the rescue rows: 128 rows, cost-free
     d2, i2, c2 = expanded_topk(sorted_ids, exp_wide, n_valid, qb,
-                               k=k, select=select, lut=None)
+                               k=k, select=select, lut=None, planes=planes)
     was_bad = jnp.take(~cert, bad)
     take = was_bad & c2
     old_idx = jnp.take(idx, bad, axis=0)
@@ -828,12 +887,12 @@ def _fallback_tile(n_rows: int, q: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps",
-                                             "d_lut_steps"))
+                                             "d_lut_steps", "planes"))
 def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
                       d_sorted, d_expanded, d_n_valid, queries,
                       lut=None, d_lut=None, *, k: int = 8,
                       select: str = "fast3", lut_steps=None,
-                      d_lut_steps=None):
+                      d_lut_steps=None, planes: int = N_LIMBS):
     """Exact k XOR-closest over (live base rows ∪ delta slab).
 
     Args: base table as in :func:`expanded_topk` (``expanded`` must use
@@ -876,7 +935,8 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     m_dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
                                       queries, k=k, select=select, lut=lut,
                                       lut_steps=lut_steps,
-                                      tomb_bits=tomb_bits, fast2_limbs=True)
+                                      tomb_bits=tomb_bits, fast2_limbs=True,
+                                      planes=planes)
 
     def exact(_):
         live = (jnp.arange(N) < n_valid) & ~unpack_tomb_bits(tomb_bits, N)
@@ -892,7 +952,7 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     dd, d_idx, d_cert = expanded_topk(d_sorted, d_expanded, d_n_valid,
                                       queries, k=k, select=select,
                                       lut=d_lut, lut_steps=d_lut_steps,
-                                      fast2_limbs=True)
+                                      fast2_limbs=True, planes=planes)
 
     def d_exact(_):
         dx, i2 = xor_topk(queries, d_sorted, k=k,

@@ -43,8 +43,8 @@ from ..ops.sorted_table import (sort_table, window_topk, build_prefix_lut,
                                 default_lut_bits, expand_table, expanded_topk,
                                 _EROW)
 from ..core.search import (simulate_lookups, _lookup_engine,
-                           _guarded_lower_bound, TARGET_NODES, ALPHA,
-                           SEARCH_NODES)
+                           _guarded_lower_bound, _lut_block_bounds,
+                           TARGET_NODES, ALPHA, SEARCH_NODES)
 
 _U32 = jnp.uint32
 
@@ -289,7 +289,8 @@ def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
 @functools.lru_cache(maxsize=16)
 def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
                     alpha: int, search_nodes: int, max_hops: int,
-                    lut_bits: int, state_limbs: int = N_LIMBS):
+                    lut_bits: int, state_limbs: int = N_LIMBS,
+                    block_bits: int = 0):
     """Compile the table-sharded iterative lookup for one geometry.
 
     Returns a jitted ``fn(sorted_ids, n_valid, targets, seed)`` whose
@@ -317,6 +318,24 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
             # shard ranges — one [M]-int32 psum over the table axis
             return lax.psum(local_lower(flat), "t")
 
+        # reply-block edges as psum'd per-shard LUT reads: a count of
+        # local rows below a prefix is one LUT entry, and Σ shards =
+        # the global position — the same values _lut_block_bounds
+        # computes single-device (same `block_bits`), so tp results
+        # stay BIT-IDENTICAL while the per-round positioning search
+        # disappears (the round-5 engine win; exp_round_r5.py).
+        # The default derives from the GLOBAL table size, never the
+        # shard size: a shard-sized width would make the clamp depth —
+        # and hence the reply stream — vary with the mesh split,
+        # breaking the cross-mesh bit-identity tp_scaling.py asserts.
+        bb = block_bits or default_lut_bits(shard_n * mesh.shape["t"])
+        block_lut = (lut if bb == lut_bits else
+                     build_prefix_lut(sorted_shard, n_local, bits=bb))
+
+        def block_bounds(t0, prefix_len):
+            lo, ub = _lut_block_bounds(block_lut, t0, prefix_len)
+            return lax.psum(lo, "t"), lax.psum(ub, "t")
+
         def gather_planar(rows, limbs=N_LIMBS):
             # distributed row fetch: the owning shard contributes the
             # row's limbs, every other shard zeros — psum reassembles.
@@ -336,7 +355,8 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
         return _lookup_engine(gather_planar, lower, n, targets_local,
                               q_index, q_total, seed.astype(_U32),
                               k=k, alpha=alpha, search_nodes=search_nodes,
-                              max_hops=max_hops, state_limbs=state_limbs)
+                              max_hops=max_hops, state_limbs=state_limbs,
+                              block_bounds=block_bounds)
 
     fn = jax.shard_map(
         local, mesh=mesh,
@@ -388,7 +408,8 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
                          f"{mesh.shape['q']}")
     shard_n = N // n_t
     fn = build_tp_lookup(mesh, shard_n, Q, k, alpha, search_nodes, max_hops,
-                         default_lut_bits(shard_n), state_limbs)
+                         default_lut_bits(shard_n), state_limbs,
+                         block_bits=default_lut_bits(N))
     sorted_ids = jax.device_put(jnp.asarray(sorted_ids, _U32),
                                 NamedSharding(mesh, P("t", None)))
     targets = jax.device_put(jnp.asarray(targets, _U32),
