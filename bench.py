@@ -196,6 +196,18 @@ def chain_slope(body, example, *consts, r1: int = 2, r2: int = 8,
 #   positioning: LUT-only (0 search steps) loses nothing at 20 LUT bits
 #              on 1M rows (max bucket ~8 ≪ the window margin) and
 #              removes ~2.5 ms of serialized element-gather steps.
+# Round 5 (2-plane expansions — expand_table limbs=2 — cut the row
+# gather 60% and moved the headline 17.86M → 21.6M) re-swept the
+# strides hunting the verdict's ≥25M (benchmarks/exp_headline_r5.py):
+#   stride 16 (48-window, 64-lane sorts): stage-1 alone 2.9 ms BUT
+#              cert 0.798 at k=16 — 26K misses/batch flood the repair
+#              stage, cascade 32.7 ms.  NEGATIVE.
+#   stride 24: cert 0.974, cascade 9.3 ms.  NEGATIVE (as in round 3).
+#   stride 32: cascade 5.7 ms — still the optimum.  The k=16 result
+#              set needs ~full stride-32 margins to certify, so the
+#              remaining cost is irreducibly the 128-lane in-window
+#              sort + gather; ≥25M was not reached and the measured
+#              reason is this certification/sort-width trade.
 # The timed kernel is cascade_topk at stride 32 with a 256-row repair
 # cap: uncertified rows are selected on device and re-looked-up against
 # the wide stride-64 expansion in the same call (a full-scan fallback

@@ -72,11 +72,14 @@ def config1() -> dict:
                                   planes=2)
         return jnp.sum(c.astype(jnp.float32))
 
-    # per-rep work is ~40 µs at this size: use deep rep counts so the
-    # slope rises above run-to-run noise (single compile either way —
-    # the trip count is traced)
-    dt_dev = chain_slope(body, jnp.asarray(queries), sorted_ids, expanded,
-                         n_valid, lut, r1=64, r2=512)
+    # per-rep work is ~30 µs at this size: tunnel noise swamped shallow
+    # chains (captured 10-52M across sessions at r2=512), so the slope
+    # uses very deep rep counts AND a median of 5 samples — the band
+    # ci/check_docs.py holds quotes to is only as tight as this
+    # measurement is stable
+    dt_dev, _lo, _hi = chain_slope(
+        body, jnp.asarray(queries), sorted_ids, expanded,
+        n_valid, lut, r1=256, r2=2048, samples=5)
     _, _, cert = jax.block_until_ready(
         expanded_topk(sorted_ids, expanded, n_valid, jnp.asarray(queries),
                       k=K, select="fast2", lut=lut, lut_steps=0, planes=2))
@@ -494,8 +497,17 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     on_accel = jax.devices()[0].platform != "cpu"
     N = 10_000_000 if on_accel else 200_000
     Q = 131_072 if on_accel else 8_192
-    DCAP = dcap or (262_144 if on_accel else 8_192)
-    E = churn or (256 if on_accel else 64)      # evictions AND inserts/round
+    # dcap sweep on v5e (round 5, 2-plane kernels): 262144 → 4.37M
+    # lookups/s (0.34× static), 65536 → 5.20M (0.43×), 16384 → see
+    # captures/; smaller slabs cut the per-round delta re-sort/expand
+    # while the 149 ms compaction amortizes over fewer rounds — 65536
+    # is the measured optimum at the default churn rate
+    DCAP = dcap or (65_536 if on_accel else 8_192)
+    # evictions AND inserts per round: absorption is scatter-cheap, so
+    # the mutation rate scales with E at ~constant round cost — 512
+    # holds the sustained rate comfortably above the reference-realistic
+    # N/600 ≈ 16.7K/s even on slow tunnel sessions
+    E = churn or (512 if on_accel else 64)
     K = 8
     lut_bits = default_lut_bits(N)
 
@@ -582,14 +594,19 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
             dslab, new_ids, (jnp.int32(nd0), 0))
         dvalid = jnp.arange(DCAP) < nd_after
         ds, _dp, dnv = sort_table(ds_slab, dvalid)
-        de = expand_table(ds, stride=32, limbs=2)
+        # narrow stride-16 delta windows (64-lane sorts — measured 27×
+        # cheaper than stride 32's 128-lane at this Q) + a wide rescue
+        # expansion for the ~0.7% of rows the narrow margin decertifies
+        # (cascade inside churn_lookup_topk — exp_churn_r5.py)
+        de = expand_table(ds, stride=16, limbs=2)
+        dew = expand_table(ds, stride=64, limbs=2)
         dlut = build_prefix_lut(ds, dnv, bits=d_bits)
         # LUT-only positioning on BOTH sides (the sequential probe-gather
         # steps dominate otherwise); fast2 = nodes-not-distances contract
         _dist, enc, cert = churn_lookup_topk(
             sorted_ids, expanded, n_valid, tomb, ds, de, dnv, q,
-            lut=lut, d_lut=dlut, k=K, select="fast2",
-            lut_steps=0, d_lut_steps=0, planes=2)
+            lut=lut, d_lut=dlut, d_exp_wide=dew, k=K, select="fast2",
+            lut_steps=0, planes=2, d_cap=4096)
         return (jnp.sum(cert.astype(jnp.float32))
                 + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9)
 
@@ -638,7 +655,8 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     dvalid = np.zeros(DCAP, bool)
     dvalid[:n_delta] = True
     ds, _dp, dnv = sort_table(jnp.asarray(delta_np), jnp.asarray(dvalid))
-    de = expand_table(ds, stride=32, limbs=2)
+    de = expand_table(ds, stride=16, limbs=2)
+    dew = expand_table(ds, stride=64, limbs=2)
     dlut = build_prefix_lut(ds, dnv, bits=d_bits)
     # fast3 oracle needs full limb planes — built transiently here only
     exp5 = expand_table(sorted_ids)
@@ -649,8 +667,8 @@ def config6(churn: int = 0, dcap: int = 0) -> dict:
     del exp5, de5
     _n, enc_f2, _ = churn_lookup_topk(
         sorted_ids, expanded, n_valid, jnp.asarray(tomb_np), ds, de, dnv,
-        qs, lut=lut, d_lut=dlut, k=K, select="fast2",
-        lut_steps=0, d_lut_steps=0, planes=2)
+        qs, lut=lut, d_lut=dlut, d_exp_wide=dew, k=K, select="fast2",
+        lut_steps=0, planes=2, d_cap=4096)
     cat = jnp.concatenate([sorted_ids, ds], axis=0)
     cval = jnp.concatenate([jnp.asarray(live_np),
                             jnp.arange(DCAP) < dnv])

@@ -1,0 +1,132 @@
+"""Churn ROUND-BODY attribution inside the real compiled program
+(ask 5 continued) — the isolated-stage numbers (exp_churn_r5.py) do not
+add up to the measured round, so, as with the search engine
+(exp_round_r5.py), each variant disables one piece of the REAL round
+body and (full − variant) attributes cost with fusion effects included.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from bench import chain_slope
+    from opendht_tpu.ops.sorted_table import (
+        sort_table, build_prefix_lut, default_lut_bits, expand_table,
+        churn_lookup_topk, expanded_topk)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = 10_000_000 if on_accel else 200_000
+    Q = 131_072 if on_accel else 8_192
+    DCAP = 65_536 if on_accel else 8_192
+    E, K = 256, 8
+    lut_bits = default_lut_bits(N)
+    d_bits = default_lut_bits(DCAP)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    del table
+    expanded = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
+    lut = jax.block_until_ready(
+        build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
+
+    rng = np.random.default_rng(70)
+    nwords = (N + 31) // 32
+    tomb_np = rng.integers(0, 2**32, size=nwords, dtype=np.uint32) & 0
+    dslab_np = rng.integers(0, 2**32, size=(DCAP, 5), dtype=np.uint32)
+    nd0 = DCAP // 2
+    tomb_base = jnp.asarray(tomb_np)
+    dslab = jnp.asarray(dslab_np)
+    new_ids = jnp.asarray(
+        rng.integers(0, 2**32, size=(E, 5), dtype=np.uint32))
+    widx = jnp.asarray(rng.integers(0, nwords, size=E, dtype=np.int64))
+    wval = jnp.zeros((E,), jnp.uint32)
+    nd_after = jnp.int32(nd0 + E)
+
+    # pre-built delta structures for the no-rebuild variant
+    ds0, _dp0, dnv0 = jax.block_until_ready(
+        sort_table(dslab, jnp.arange(DCAP) < nd_after))
+    de0 = jax.block_until_ready(expand_table(ds0, stride=16, limbs=2))
+    dew0 = jax.block_until_ready(expand_table(ds0, stride=64, limbs=2))
+    dlut0 = jax.block_until_ready(build_prefix_lut(ds0, dnv0, bits=d_bits))
+
+    def make_round(variant):
+        def round_body(q, sorted_ids, expanded, lut, n_valid, tomb_base,
+                       widx, wval, dslab, new_ids, nd_after,
+                       ds0, de0, dew0, dlut0):
+            tomb = tomb_base.at[widx].set(wval)
+            if variant == "no_rebuild":
+                ds, de, dew, dlut, dnv = ds0, de0, dew0, dlut0, nd_after
+            else:
+                ds_slab = lax.dynamic_update_slice(
+                    dslab, new_ids, (jnp.int32(nd0), 0))
+                dvalid = jnp.arange(DCAP) < nd_after
+                ds, _dp, dnv = sort_table(ds_slab, dvalid)
+                de = expand_table(ds, stride=16, limbs=2)
+                dew = expand_table(ds, stride=64, limbs=2)
+                dlut = build_prefix_lut(ds, dnv, bits=d_bits)
+            if variant == "base_only":
+                _d, enc, cert = expanded_topk(
+                    sorted_ids, expanded, n_valid, q, k=K, select="fast2",
+                    lut=lut, lut_steps=0, planes=2, tomb_bits=tomb)
+                return (jnp.sum(cert.astype(jnp.float32))
+                        + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9
+                        + de[0, 0].astype(jnp.float32) * 1e-9
+                        + dew[0, 0].astype(jnp.float32) * 1e-9
+                        + dlut[1].astype(jnp.float32) * 1e-9)
+            if variant == "delta_only":
+                from opendht_tpu.ops.sorted_table import cascade_topk
+                _d, enc, cert = cascade_topk(
+                    ds, de, dew, dnv, q, dlut, k=K, select="fast2",
+                    cap=4096, planes=2, fast2_limbs=True)
+                return (jnp.sum(cert.astype(jnp.float32))
+                        + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9)
+            _dist, enc, cert = churn_lookup_topk(
+                sorted_ids, expanded, n_valid, tomb, ds, de, dnv, q,
+                lut=lut, d_lut=dlut, d_exp_wide=dew, k=K, select="fast2",
+                lut_steps=0, planes=2, d_cap=4096)
+            return (jnp.sum(cert.astype(jnp.float32))
+                    + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9)
+        return round_body
+
+    base = None
+    for v in ("full", "no_rebuild", "base_only", "delta_only"):
+        dt = chain_slope(make_round(v), queries, sorted_ids, expanded, lut,
+                         n_valid, tomb_base, widx, wval, dslab, new_ids,
+                         nd_after, ds0, de0, dew0, dlut0, r1=2, r2=8)
+        rec = {"variant": v, "ms": round(dt * 1e3, 2)}
+        if v == "full":
+            base = dt
+        elif base:
+            rec["delta_vs_full_ms"] = round((base - dt) * 1e3, 2)
+        print(json.dumps(rec), flush=True)
+
+    # static comparator, same session
+    def static_body(q, sorted_ids, expanded, lut, n_valid):
+        d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
+                                  select="fast2", lut=lut, lut_steps=0,
+                                  planes=2)
+        return (jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
+
+    dt = chain_slope(static_body, queries, sorted_ids, expanded, lut,
+                     n_valid, r1=2, r2=8)
+    print(json.dumps({"variant": "static (no churn structures)",
+                      "ms": round(dt * 1e3, 2)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

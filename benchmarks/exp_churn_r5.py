@@ -1,0 +1,143 @@
+"""Churn-round decomposition (ask 5: config6 ≥0.85× static).
+
+With 2-plane expansions the static lookup dropped to ~10 ms/131K wave,
+exposing the delta side as ~2/3 of the churn round.  This measures each
+round component on the chip so the rebuild targets the measured cost:
+per-round delta re-sort/expand/LUT at several slab tiers, the delta
+window lookup at stride 32 vs 16, the 2k merge sort row- vs
+column-oriented, and the tombstone overhead on the base side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from bench import chain_slope
+    from opendht_tpu.ops.sorted_table import (
+        sort_table, build_prefix_lut, default_lut_bits, expand_table,
+        expanded_topk)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = 10_000_000 if on_accel else 200_000
+    Q = 131_072 if on_accel else 8_192
+    K = 8
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    del table
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    exp2 = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
+    nwords = (N + 31) // 32
+    tomb = jnp.zeros((nwords,), jnp.uint32)
+
+    def report(name, dt):
+        print(json.dumps({"stage": name, "ms": round(dt * 1e3, 3)}),
+              flush=True)
+
+    # base lookup with and without tombstones
+    def base(q, sorted_ids, exp2, n_valid, lut):
+        d, i, c = expanded_topk(sorted_ids, exp2, n_valid, q, k=K,
+                                select="fast2", lut=lut, lut_steps=0,
+                                planes=2)
+        return (jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(i[:, 0].astype(jnp.float32)) * 1e-9)
+
+    def base_tomb(q, sorted_ids, exp2, n_valid, lut, tomb):
+        d, i, c = expanded_topk(sorted_ids, exp2, n_valid, q, k=K,
+                                select="fast2", lut=lut, lut_steps=0,
+                                planes=2, tomb_bits=tomb)
+        return (jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(i[:, 0].astype(jnp.float32)) * 1e-9)
+
+    report("base lookup (static)", chain_slope(
+        base, queries, sorted_ids, exp2, n_valid, lut, r1=4, r2=16))
+    report("base lookup + tomb", chain_slope(
+        base_tomb, queries, sorted_ids, exp2, n_valid, lut, tomb,
+        r1=4, r2=16))
+
+    for DCAP in (262_144, 65_536, 16_384):
+        if not on_accel and DCAP > 65_536:
+            continue
+        kd = jax.random.PRNGKey(100 + DCAP)
+        dslab = jax.random.bits(kd, (DCAP, 5), dtype=jnp.uint32)
+        nd = jnp.int32(DCAP // 2)
+        d_bits = default_lut_bits(DCAP)
+
+        # per-round delta rebuild: sort + expand + lut
+        def rebuild(q, dslab, nd, stride):
+            dvalid = jnp.arange(DCAP) < (nd ^ (q[0, 0] & 1).astype(jnp.int32))
+            ds, _dp, dnv = sort_table(dslab, dvalid)
+            de = expand_table(ds, stride=stride, limbs=2)
+            dl = build_prefix_lut(ds, dnv, bits=d_bits)
+            return (ds[0, 0].astype(jnp.float32) * 1e-9
+                    + de[0, 0].astype(jnp.float32) * 1e-9
+                    + dl[1].astype(jnp.float32) * 1e-9)
+
+        for stride in (32, 16):
+            dt = chain_slope(
+                (lambda s: lambda q, dslab, nd: rebuild(q, dslab, nd, s))(
+                    stride),
+                queries, dslab, nd, r1=4, r2=16)
+            report(f"delta rebuild D={DCAP} s={stride}", dt)
+
+        # delta window lookup
+        ds, _dp, dnv = jax.block_until_ready(
+            sort_table(dslab, jnp.arange(DCAP) < nd))
+        dl = jax.block_until_ready(build_prefix_lut(ds, dnv, bits=d_bits))
+        for stride in (32, 16):
+            de = jax.block_until_ready(
+                expand_table(ds, stride=stride, limbs=2))
+
+            def dlook(q, ds, de, dnv, dl):
+                d, i, c = expanded_topk(ds, de, dnv, q, k=K,
+                                        select="fast2", lut=dl,
+                                        lut_steps=0, planes=2)
+                return (jnp.sum(c.astype(jnp.float32))
+                        + jnp.sum(i[:, 0].astype(jnp.float32)) * 1e-9)
+
+            dt = chain_slope(dlook, queries, ds, de, dnv, dl, r1=4, r2=16)
+            _, _, cert = jax.block_until_ready(
+                expanded_topk(ds, de, dnv, queries, k=K, select="fast2",
+                              lut=dl, lut_steps=0, planes=2))
+            report(f"delta lookup D={DCAP} s={stride} "
+                   f"cert={float(np.asarray(cert).mean()):.5f}", dt)
+            del de
+
+    # the 2k merge sort: row-wise [Q, 2k] vs transposed [2k, Q]
+    km = jax.random.split(jax.random.PRNGKey(9), 3)
+    m0 = jax.random.bits(km[0], (Q, 2 * K), dtype=jnp.uint32)
+    m1 = jax.random.bits(km[1], (Q, 2 * K), dtype=jnp.uint32)
+    enc = jax.random.bits(km[2], (Q, 2 * K), dtype=jnp.uint32) \
+        .astype(jnp.int32)
+
+    def merge_row(q, m0, m1, enc):
+        o = lax.sort((m0 ^ q[:, :1], m1, enc), dimension=1, num_keys=3)
+        return jnp.sum(o[2][:, :K].astype(jnp.float32)) * 1e-9
+
+    def merge_col(q, m0t, m1t, enct):
+        o = lax.sort((m0t ^ q[:, 0][None, :], m1t, enct), dimension=0,
+                     num_keys=3)
+        return jnp.sum(o[2][:K].astype(jnp.float32)) * 1e-9
+
+    report("merge sort [Q,16] row", chain_slope(
+        merge_row, queries, m0, m1, enc, r1=64, r2=512))
+    report("merge sort [16,Q] col", chain_slope(
+        merge_col, queries, m0.T, m1.T, enc.T, r1=64, r2=512))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

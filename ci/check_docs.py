@@ -89,7 +89,10 @@ def check_headline(failures):
 
 def check_config_captures(failures):
     """Each captures/<name>.json must back at least one tagged README
-    quote, and every tagged quote must sit within its band."""
+    quote, every tagged quote must sit within its band, and — the
+    other direction — every ``<!-- capture:name -->`` tag in the docs
+    must have its artifact on disk (a tag whose artifact is missing
+    would otherwise be silently unenforced)."""
     checked = []
     readme = os.path.join(ROOT, "README.md")
     docs = {}
@@ -97,6 +100,15 @@ def check_config_captures(failures):
         path = os.path.join(ROOT, name)
         if os.path.exists(path):
             docs[name] = open(path).read().splitlines()
+    for doc, lines in docs.items():
+        for ln in lines:
+            for tag in re.findall(r"<!-- capture:([\w-]+) -->", ln):
+                if not os.path.exists(os.path.join(ROOT, "captures",
+                                                   tag + ".json")):
+                    failures.append(
+                        f"{doc}: tagged quote 'capture:{tag}' has no "
+                        f"captures/{tag}.json artifact — the quote is "
+                        f"unenforced")
     for cap_path in sorted(glob.glob(os.path.join(ROOT, "captures",
                                                   "*.json"))):
         cname = os.path.splitext(os.path.basename(cap_path))[0]

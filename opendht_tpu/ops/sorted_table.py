@@ -635,9 +635,13 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
                      for l in range(2)]
         top_idx = jnp.where(valid_k, out[2][:, :k], -1)
         # fast2_limbs: hand the sorted top-64 distance bits to the
-        # caller as [Q, k, 2] (churn_lookup_topk merges on them without
-        # re-gathering ids — a [Q·k] row gather costs ~ms at Q=131K)
-        top_dist = (jnp.stack(top_limbs, axis=-1) if fast2_limbs else None)
+        # caller as a TUPLE of 2-D [Q, k] planes (churn_lookup_topk
+        # merges on them without re-gathering ids).  Planes, not a
+        # [Q, k, 2] stack: a minor dim of 2 pads to 128 lanes in TPU
+        # tiled layout — the stacked form materialized 64× the bytes
+        # and showed up as ~5 ms of unattributed churn-round cost
+        # (benchmarks/exp_churn2_r5.py).
+        top_dist = (tuple(top_limbs) if fast2_limbs else None)
         # tie-check operands (same layout as the keyed form below)
         tie_a0, tie_a1 = out[0][:, :k + 1], out[1][:, :k + 1]
         tie_av = out[2][:, :k + 1] != gr_sent
@@ -691,10 +695,11 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     return top_dist, top_idx, certified
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select", "cap", "planes"))
+@functools.partial(jax.jit, static_argnames=("k", "select", "cap", "planes",
+                                             "fast2_limbs"))
 def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
                  k: int = 8, select: str = "fast2", cap: int = 512,
-                 planes: int = N_LIMBS):
+                 planes: int = N_LIMBS, fast2_limbs: bool = False):
     """Two-stage certified lookup in ONE device call — the headline
     kernel (bench.py).
 
@@ -720,7 +725,7 @@ def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
     """
     d, idx, cert = expanded_topk(sorted_ids, exp_fast, n_valid, queries,
                                  k=k, select=select, lut=lut, lut_steps=0,
-                                 planes=planes)
+                                 planes=planes, fast2_limbs=fast2_limbs)
     # fill_value=0 pads `bad` with duplicate index 0 when fewer than
     # `cap` rows decertify, so the .at[bad].set scatters below write row
     # 0 repeatedly.  That is deterministic ONLY because every duplicate
@@ -732,16 +737,29 @@ def cascade_topk(sorted_ids, exp_fast, exp_wide, n_valid, queries, lut, *,
     # (Same invariant as _lookup_engine's compaction in core/search.py.)
     bad = jnp.nonzero(~cert, size=cap, fill_value=0)[0]
     qb = jnp.take(queries, bad, axis=0)
-    # full-depth positioning for the rescue rows: 128 rows, cost-free
+    # LUT-started bounded positioning for the rescue rows too: the
+    # sequential probe-gather steps are the stage's serial cost (full
+    # depth = 17-21 steps; the budget search ≈ 6), and a mispositioned
+    # rescue on an adversarial table merely stays uncertified — the
+    # residual flag routes it to the caller's exact fallback, so
+    # soundness never depends on the LUT.  (Full-depth stage 2 measured
+    # 3× the whole delta-cascade cost at cap=4096 in the churn round.)
     d2, i2, c2 = expanded_topk(sorted_ids, exp_wide, n_valid, qb,
-                               k=k, select=select, lut=None, planes=planes)
+                               k=k, select=select, lut=lut, lut_steps=None,
+                               planes=planes, fast2_limbs=fast2_limbs)
     was_bad = jnp.take(~cert, bad)
     take = was_bad & c2
     old_idx = jnp.take(idx, bad, axis=0)
     idx = idx.at[bad].set(jnp.where(take[:, None], i2, old_idx))
     if d is not None and d2 is not None:
-        old_d = jnp.take(d, bad, axis=0)
-        d = d.at[bad].set(jnp.where(take[:, None, None], d2, old_d))
+        if isinstance(d, tuple):               # fast2_limbs 2-D planes
+            d = tuple(
+                dp.at[bad].set(jnp.where(take[:, None], d2p,
+                                         jnp.take(dp, bad, axis=0)))
+                for dp, d2p in zip(d, d2))
+        else:
+            old_d = jnp.take(d, bad, axis=0)
+            d = d.at[bad].set(jnp.where(take[:, None, None], d2, old_d))
     cert = cert.at[bad].set(jnp.take(cert, bad) | c2)
     return d, idx, cert
 
@@ -887,12 +905,14 @@ def _fallback_tile(n_rows: int, q: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps",
-                                             "d_lut_steps", "planes"))
+                                             "d_lut_steps", "planes",
+                                             "d_cap"))
 def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
                       d_sorted, d_expanded, d_n_valid, queries,
-                      lut=None, d_lut=None, *, k: int = 8,
+                      lut=None, d_lut=None, d_exp_wide=None, *, k: int = 8,
                       select: str = "fast3", lut_steps=None,
-                      d_lut_steps=None, planes: int = N_LIMBS):
+                      d_lut_steps=None, planes: int = N_LIMBS,
+                      d_cap: int = 1024):
     """Exact k XOR-closest over (live base rows ∪ delta slab).
 
     Args: base table as in :func:`expanded_topk` (``expanded`` must use
@@ -932,6 +952,12 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     fast2 = select == "fast2"
     nl = 2 if fast2 else N_LIMBS
 
+    def _pl(x, l):
+        """Limb plane l of carried distances: fast2 hands a tuple of
+        2-D [Q,k] planes (lane-padding economics — see expanded_topk
+        fast2_limbs), fast3 a [Q,k,5] array."""
+        return x[l] if isinstance(x, tuple) else x[..., l]
+
     m_dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
                                       queries, k=k, select=select, lut=lut,
                                       lut_steps=lut_steps,
@@ -943,24 +969,42 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
         dx, i2 = xor_topk(queries, sorted_ids, k=k,
                           tile=_fallback_tile(N, Q), valid=live)
         keep = cert[:, None]
-        return (jnp.where(keep, idx, i2),
-                jnp.where(keep[..., None], m_dist, dx[..., :nl]))
+        i_out = jnp.where(keep, idx, i2)
+        if fast2:
+            return (i_out, tuple(jnp.where(keep, m_dist[l], dx[..., l])
+                                 for l in range(nl)))
+        return (i_out, jnp.where(keep[..., None], m_dist, dx[..., :nl]))
 
     m_idx, m_dist = lax.cond(jnp.all(cert), lambda _: (idx, m_dist),
                              exact, operand=None)
 
-    dd, d_idx, d_cert = expanded_topk(d_sorted, d_expanded, d_n_valid,
-                                      queries, k=k, select=select,
-                                      lut=d_lut, lut_steps=d_lut_steps,
-                                      fast2_limbs=True, planes=planes)
+    if d_exp_wide is not None:
+        # NARROW-delta cascade: the delta slab takes a stride-16
+        # expansion (48-row windows sort in 64 padded lanes — measured
+        # 27× cheaper per 131K batch than stride 32's 128-lane sorts)
+        # whose ~0.7% uncertified rows are repaired on device against
+        # the wide expansion, exactly like the headline cascade_topk.
+        # Without this, one decertified row would flip the whole batch
+        # into the O(Q·D) exact scan every round.
+        dd, d_idx, d_cert = cascade_topk(
+            d_sorted, d_expanded, d_exp_wide, d_n_valid, queries, d_lut,
+            k=k, select=select, cap=d_cap, planes=planes, fast2_limbs=True)
+    else:
+        dd, d_idx, d_cert = expanded_topk(d_sorted, d_expanded, d_n_valid,
+                                          queries, k=k, select=select,
+                                          lut=d_lut, lut_steps=d_lut_steps,
+                                          fast2_limbs=True, planes=planes)
 
     def d_exact(_):
         dx, i2 = xor_topk(queries, d_sorted, k=k,
                           tile=_fallback_tile(D, Q),
                           valid=jnp.arange(D) < d_n_valid)
         keep = d_cert[:, None]
-        return (jnp.where(keep, d_idx, i2),
-                jnp.where(keep[..., None], dd, dx[..., :nl]))
+        i_out = jnp.where(keep, d_idx, i2)
+        if fast2:
+            return (i_out, tuple(jnp.where(keep, dd[l], dx[..., l])
+                                 for l in range(nl)))
+        return (i_out, jnp.where(keep[..., None], dd, dx[..., :nl]))
 
     d_idx, dd = lax.cond(jnp.all(d_cert), lambda _: (d_idx, dd),
                          d_exact, operand=None)
@@ -977,8 +1021,8 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     enc_m = jnp.where(m_valid, m_idx, _ENC_SENT)
     enc_d = jnp.where(d_valid, d_idx + N, _ENC_SENT)
     limb_ops = tuple(
-        jnp.concatenate([jnp.where(m_valid, m_dist[..., l], big),
-                         jnp.where(d_valid, dd[..., l], big)], axis=1)
+        jnp.concatenate([jnp.where(m_valid, _pl(m_dist, l), big),
+                         jnp.where(d_valid, _pl(dd, l), big)], axis=1)
         for l in range(nl)
     )
     enc_all = jnp.concatenate([enc_m, enc_d], axis=1)

@@ -141,6 +141,8 @@ class Dht:
         self._table_grow_time = {af: _NEVER for af in self.tables}
         self.status_cb: Optional[Callable[[NodeStatus, NodeStatus], None]] = None
         self._last_status = {af: NodeStatus.DISCONNECTED for af in self.tables}
+        self._status_checked: Dict[int, float] = {}
+        self._status_recheck: Dict[int, object] = {}
 
         # write-token secrets, rotated every 15-45 min (dht.cpp:1369-1379)
         self._secret = os.urandom(8)
@@ -215,17 +217,23 @@ class Dht:
             return [[] for _ in targets]
         now = self.scheduler.time()
         rows, _dist = table.find_closest(list(targets), k=count, now=now)
+        # one vectorized id conversion for the whole result matrix — the
+        # per-row numpy round-trip dominated big batches (table.py
+        # ids_of_rows)
+        ids_flat = table.ids_of_rows(rows)
         out: List[List[Node]] = []
+        k_out = rows.shape[1]
         for qi in range(rows.shape[0]):
             nodes: List[Node] = []
-            for r in rows[qi]:
+            for j in range(k_out):
+                r = rows[qi, j]
                 if r < 0:
                     continue
                 addr = table.addr_of(int(r))
                 if addr is None:
                     continue
                 nodes.append(self.engine.cache.get_node(
-                    table.id_of(int(r)), addr, now, confirm=False))
+                    ids_flat[qi * k_out + j], addr, now, confirm=False))
             out.append(nodes)
         return out
 
@@ -292,7 +300,7 @@ class Dht:
         if not was_known or confirm:
             self._try_search_insert(node)
         if confirm:
-            self._update_status(node.family)
+            self._update_status(node.family, debounce=True)
 
     def _on_reported_addr(self, _id: InfoHash, addr: Optional[SockAddr]) -> None:
         """Collect peers' echoes of our public address
@@ -1577,7 +1585,24 @@ class Dht:
             return NodeStatus.CONNECTING
         return NodeStatus.DISCONNECTED
 
-    def _update_status(self, af: int) -> None:
+    def _update_status(self, af: int, *, debounce: bool = False) -> None:
+        """Re-evaluate the node status and fire status_cb on change.
+
+        ``debounce=True`` (the per-packet on_new_node path) rates the
+        O(table) ``get_nodes_stats`` sweep at once per second of node
+        time, rescheduling itself for the window's end so a transition
+        is delayed ≤ 1 s, never lost.  Un-debounced, the sweep ran once
+        per confirmed node event and was the top profile entry of big
+        virtual clusters (381K calls over an 84 s 1024-node run)."""
+        now = self.scheduler.time()
+        if debounce:
+            last = self._status_checked.get(af, float("-inf"))
+            if now - last < 1.0:
+                if not self._status_recheck.get(af):
+                    self._status_recheck[af] = self.scheduler.add(
+                        last + 1.0, lambda: self._status_tick(af))
+                return
+            self._status_checked[af] = now
         st = self.get_status(af)
         if st is not self._last_status.get(af):
             self._last_status[af] = st
@@ -1587,6 +1612,18 @@ class Dht:
                                           NodeStatus.DISCONNECTED),
                     self._last_status.get(_socket.AF_INET6,
                                           NodeStatus.DISCONNECTED))
+
+    def _status_tick(self, af: int) -> None:
+        """The scheduled end-of-window re-evaluation: ALWAYS does the
+        full check.  It must not re-enter the window logic — float
+        rounding can make ``(last + 1.0) - last < 1.0``, and the
+        re-entered window branch would then re-schedule the job at its
+        own (already due) fire time: an infinite self-rescheduling loop
+        at a frozen virtual clock (measured: 5M ticks in 0.5 virtual
+        seconds before this fix)."""
+        self._status_recheck.pop(af, None)
+        self._status_checked[af] = self.scheduler.time()
+        self._update_status(af)
 
     def network_size_estimate(self, af: int = _socket.AF_INET) -> int:
         table = self._table(af)

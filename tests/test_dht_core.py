@@ -217,3 +217,35 @@ def test_repeated_put_fires_done_cb():
     nodes[2].put(key, val, lambda ok, ns: second.update(ok=ok))
     assert net.run(60, lambda: "ok" in second), "second put lost its done_cb"
     assert second["ok"]
+
+
+def test_status_debounce_no_self_rescheduling_loop():
+    """The debounced status recheck must never re-enter the window
+    logic when its job fires: float rounding can make
+    ``(last + 1.0) - last < 1.0``, and the re-entered branch would
+    re-schedule the job at its own (already due) time — an infinite
+    loop at a frozen virtual clock (caught at 5M events/0.5 virtual s
+    by the hop-parity protocol leg)."""
+    from opendht_tpu.scheduler import Scheduler
+
+    # a time where float addition rounds (t + 1.0) - t below 1.0
+    t0 = 3.0359290344407412
+    clock = {"t": t0}
+    sched = Scheduler(clock=lambda: clock["t"])
+    dht = Dht(lambda data, addr: 0, scheduler=sched, has_v6=False)
+    ticks = {"n": 0}
+    orig = dht._status_tick
+
+    def counted(af):
+        ticks["n"] += 1
+        return orig(af)
+
+    dht._status_tick = counted
+    af = socket.AF_INET
+    dht._update_status(af, debounce=True)      # full check: checked = t0
+    dht._update_status(af, debounce=True)      # in-window: schedules tick
+    clock["t"] = t0 + 1.0                      # may round under t0+1.0-t0
+    for _ in range(50):
+        sched.run()
+        dht._update_status(af, debounce=True)
+    assert ticks["n"] <= 3, f"runaway recheck loop: {ticks['n']} ticks"

@@ -247,7 +247,11 @@ def test_nodetable_revival_returns_once():
     assert len(got) == len(set(got)) == 20
 
 
-def test_nodetable_delta_overflow_forces_compaction():
+def test_nodetable_delta_overflow_grows_and_compacts_nonblocking():
+    """Delta overflow no longer stalls: the slab doubles, the base view
+    keeps serving, and a BACKGROUND compaction is dispatched that the
+    next view() installs — with every post-dispatch mutation replayed
+    (round-4 verdict ask #5).  Lookups stay exact throughout."""
     rng = np.random.default_rng(9)
     self_id = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
     t = NodeTable(self_id, capacity=512, k=64, delta_cap=8)
@@ -258,8 +262,95 @@ def test_nodetable_delta_overflow_forces_compaction():
     for h in _rand_hashes(rng, 8):                 # fills delta_cap=8
         t.insert(h, None, now=3.0, confirm=2)
     assert t._snap is base and t.churn_pending == 8
-    t.insert(_rand_hashes(rng, 1)[0], None, now=4.0, confirm=2)
-    assert t._snap is None                         # overflow → rebuild due
+    c0 = t.compactions
+    over = _rand_hashes(rng, 3)
+    t.insert(over[0], None, now=4.0, confirm=2)
+    # overflow: base SURVIVES (no stall), delta doubled, rebuild pending
+    assert t._snap is base
+    assert t._pending_base is not None
+    assert t._churn.delta_ids_np.shape[0] == 16
+    # mutations after dispatch land in the view AND the replay log
+    t.insert(over[1], None, now=4.5, confirm=2)
+    t.on_expired(over[0])
+    assert len(t._pending_base["mutlog"]) >= 2
+    # lookups during the pending window are exact vs the host oracle
+    q = K.ids_from_hashes(over[:2])
+    rows_dev, dist_dev = t._churn.lookup(q, k=8)
+    rows_host, dist_host = t._find_closest_host(q, 8, 5.0, "reachable")
+    np.testing.assert_array_equal(dist_dev, dist_host)
+    # the swap installs the new base and replays the log
+    v = t.view(6.0)
+    assert t._pending_base is None
+    assert t.compactions == c0 + 1
+    assert t._snap is not base
+    rows2, dist2 = v.lookup(q, k=8)
+    np.testing.assert_array_equal(dist2, dist_host)
+    # the replayed view agrees with a forced full rebuild
+    t.snapshot(now=7.0)
+    rows3, dist3 = t.view(7.0).lookup(q, k=8)
+    np.testing.assert_array_equal(dist3, dist_host)
+
+
+def test_bulk_load_during_pending_compaction_replays_at_swap(monkeypatch):
+    """Rows bulk-loaded while a background compaction is in flight must
+    reach the pending build's mutation log — or they vanish from the
+    serving view at swap (review finding on the round-5 non-blocking
+    compaction; bulk_load now routes through _absorb_insert)."""
+    import opendht_tpu.core.table as table_mod
+    monkeypatch.setattr(table_mod, "TOMB_MIN", 16)
+    rng = np.random.default_rng(41)
+    self_id = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+    t = NodeTable(self_id, capacity=1024, k=64, delta_cap=128)
+    for h in _rand_hashes(rng, 300):
+        t.insert(h, None, now=1.0, confirm=2)
+    t.snapshot(now=2.0)
+    live = [t.id_of(int(r)) for r in np.nonzero(t._valid)[0][:20]]
+    for h in live:
+        t.on_expired(h)                    # crosses the patched limit
+    assert t._pending_base is not None
+    fresh = rng.integers(0, 2**32, size=(12, 5), dtype=np.uint32)
+    t.bulk_load(fresh, now=3.0)            # lands while pending
+    assert any(op == "i" for op, _ in t._pending_base["mutlog"])
+    v = t.view(4.0)                        # installs the swap + replay
+    assert t._pending_base is None
+    rows, dist = v.lookup(fresh[:4], k=1)
+    # every bulk-loaded id must be found at distance zero
+    for qi in range(4):
+        assert rows[qi, 0] >= 0
+        assert np.array_equal(t._ids[int(rows[qi, 0])], fresh[qi])
+
+
+def test_nodetable_tombstone_limit_compacts_nonblocking(monkeypatch):
+    """Crossing the tombstone limit dispatches a background rebuild
+    instead of invalidating the view; serving continues from the old
+    base + tombstones until the swap."""
+    import opendht_tpu.core.table as table_mod
+    monkeypatch.setattr(table_mod, "TOMB_MIN", 32)
+    rng = np.random.default_rng(29)
+    self_id = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+    t = NodeTable(self_id, capacity=1024, k=64, delta_cap=64)
+    ids = _rand_hashes(rng, 400)
+    for h in ids:
+        t.insert(h, None, now=1.0, confirm=2)
+    t.snapshot(now=2.0)
+    base = t._snap
+    # expire enough LIVE rows to cross the (patched) tombstone floor —
+    # expiry tombstones without promoting cached candidates
+    live = [t.id_of(int(r)) for r in np.nonzero(t._valid)[0][:40]]
+    for h in live:
+        t.on_expired(h)
+    assert t._snap is base                   # still serving
+    assert t._pending_base is not None       # rebuild dispatched
+    q = K.ids_from_hashes([t.id_of(int(r))
+                           for r in np.nonzero(t._valid)[0][-8:]])
+    rows_dev, dist_dev = t._churn.lookup(q, k=8)
+    rows_host, dist_host = t._find_closest_host(q, 8, 3.0, "reachable")
+    np.testing.assert_array_equal(dist_dev, dist_host)
+    # the next view installs the swap; results unchanged
+    v = t.view(4.0)
+    assert t._pending_base is None
+    _, dist2 = v.lookup(q, k=8)
+    np.testing.assert_array_equal(dist2, dist_host)
 
 
 def test_nodetable_bulk_load_absorbed_into_delta():

@@ -505,6 +505,44 @@ def test_expanded_topk_two_plane_bitwise_identical(stride):
                       lut=lut, planes=2)
 
 
+def test_churn_lookup_narrow_delta_cascade_exact():
+    """The stride-16 narrow-delta cascade (d_exp_wide + d_cap) must be
+    exact vs the full-re-sort oracle — including when the narrow margin
+    decertifies rows (they repair against the wide expansion, and any
+    residual goes to the exact cond)."""
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, expand_table,
+                                              churn_lookup_topk)
+    from opendht_tpu.ops.xor_topk import xor_topk
+    rng = np.random.default_rng(81)
+    N, D = 4096, 1024
+    raw = _rand_raw(N, 82)
+    sorted_ids, perm, n_valid = sort_table(jnp.asarray(K.ids_from_bytes(raw)))
+    lut = build_prefix_lut(sorted_ids, n_valid)
+    tomb = np.zeros((N + 31) // 32, np.uint32)
+    dead = rng.choice(N, size=200, replace=False)
+    np.bitwise_or.at(tomb, dead >> 5,
+                     np.uint32(1) << (dead & 31).astype(np.uint32))
+    # clustered delta: shared prefixes force narrow-window decertification
+    d_raw = _rand_raw(D, 83, cluster=6)
+    ds, dp, dnv = sort_table(jnp.asarray(K.ids_from_bytes(d_raw)))
+    dlut = build_prefix_lut(ds, dnv)
+    q_raw = np.concatenate([_rand_raw(96, 84), d_raw[:32]], axis=0)
+    q = jnp.asarray(K.ids_from_bytes(q_raw))
+    _, enc, cert = churn_lookup_topk(
+        sorted_ids, expand_table(sorted_ids, stride=32, limbs=2), n_valid,
+        jnp.asarray(tomb), ds, expand_table(ds, stride=16, limbs=2), dnv,
+        q, lut=lut, d_lut=dlut,
+        d_exp_wide=expand_table(ds, stride=64, limbs=2),
+        k=8, select="fast2", lut_steps=0, planes=2, d_cap=64)
+    assert bool(np.asarray(cert).all())
+    live = np.ones(N, bool)
+    live[dead] = False
+    cat = jnp.concatenate([sorted_ids, ds], axis=0)
+    cval = jnp.concatenate([jnp.asarray(live), jnp.arange(D) < dnv])
+    _, i_ref = xor_topk(q, cat, k=8, valid=cval)
+    np.testing.assert_array_equal(np.asarray(enc), np.asarray(i_ref))
+
+
 def test_churn_lookup_two_plane_matches():
     """churn_lookup_topk with 2-plane base+delta expansions (fast2) is
     bit-identical to the 5-plane fast2 churn path and exact vs the

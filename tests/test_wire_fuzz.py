@@ -20,10 +20,10 @@ import socket
 import msgpack
 import pytest
 
-from opendht_tpu.core.value import Value
+from opendht_tpu.core.value import MAX_VALUE_SIZE, Value
 from opendht_tpu.infohash import InfoHash
 from opendht_tpu.net import EngineCallbacks, NetworkEngine, ParsedMessage
-from opendht_tpu.net.engine import MAX_PACKET_VALUE_SIZE, RX_MAX_PACKET_TIME
+from opendht_tpu.net.engine import RX_MAX_PACKET_TIME
 from opendht_tpu.net.parsed_message import pack_tid
 from opendht_tpu.scheduler import Scheduler
 from opendht_tpu.sockaddr import SockAddr
@@ -189,8 +189,7 @@ def test_hostile_fragment_sequences():
     assert not eng._partials
 
     # oversized total: the size entry is skipped entirely
-    eng.process_message(_announce(78, MAX_VALUE_SIZE_PLUS := (
-        64 * 1024 + 33), nid, ih), SRC)
+    eng.process_message(_announce(78, MAX_VALUE_SIZE + 33, nid, ih), SRC)
     assert 78 not in eng._partials
 
     # good announce then hostile parts
