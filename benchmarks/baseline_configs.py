@@ -275,7 +275,13 @@ def config3(Q: int = 0, N: int = 0, chunk: int = 0,
         if c > Q or c in sweep:
             continue
         w = targets[:c]
-        cdt = chain_slope(body, w, sorted_ids, n_valid, lut, r1=1, r2=4)
+        # small waves are ~3-15 ms — far below the tunnel noise floor
+        # at shallow rep counts (r2=4 captured 8.65 vs 14.48 ms for the
+        # same 4096-wave across sessions, nonmonotonic vs 1024).  Deep
+        # chains + a median-of-3 make the sweep quotable.
+        r1s = max(2, 32_768 // c)
+        cdt, _lo, _hi = chain_slope(body, w, sorted_ids, n_valid, lut,
+                                    r1=r1s, r2=4 * r1s, samples=3)
         sweep[c] = {"latency_ms": round(cdt * 1e3, 2),
                     "lookups_per_s": round(c / cdt, 1)}
     out["latency_sweep"] = sweep

@@ -125,7 +125,11 @@ def check_config_captures(failures):
                 if tag not in ln:
                     continue
                 any_tagged = True
-                for rate, txt in _rate_quotes(ln):
+                # only the line's FIRST rate figure is the artifact's
+                # primary value; later figures on the same line quote
+                # secondary fields (e.g. the latency sweep's per-wave
+                # rates), each checked by its own field rule below
+                for rate, txt in _rate_quotes(ln)[:1]:
                     if not (0.85 * cap["value"] <= rate
                             <= 1.15 * cap["value"]):
                         failures.append(

@@ -304,13 +304,22 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
         """Simulated answers of the α queried nodes per search.
         x_rows [W, alpha] int32 (−1 = no request) → node rows [W, R]."""
         W = tgt.shape[0]
-        x_l = gather_planar(x_rows, N_LIMBS)     # full ids: cb is exact
-        t_l = [tgt[:, l:l + 1] for l in range(N_LIMBS)]
-        b = _common_bits_planar(x_l, t_l)                            # [W,a]
-        prefix_len = jnp.clip(b + 1, 0, ID_BITS)
         if block_bounds is not None:
-            lo, ub = block_bounds(tgt[:, 0:1], prefix_len)
+            # 1-LIMB cb: the LUT block read clamps prefixes at its
+            # ≤24-bit width, so any cb ≥ 32 yields the same clamped
+            # edges — computing cb from limb 0 alone (exact below 32,
+            # 32 for deeper) is BIT-IDENTICAL through the LUT while the
+            # per-round x_l gather fetches 1 plane instead of 5 (the
+            # gathers are issue-bound — ~1 ms of the ~5.5 ms round at
+            # W=16K).  block_mode="exact" keeps the full-width path.
+            x0 = gather_planar(x_rows, 1)[0]
+            b = clz32(x0 ^ tgt[:, 0:1])          # clz32(0) == 32 by contract
+            lo, ub = block_bounds(tgt[:, 0:1], b + 1)
         else:
+            x_l = gather_planar(x_rows, N_LIMBS)     # full ids: exact cb
+            t_l = [tgt[:, l:l + 1] for l in range(N_LIMBS)]
+            b = _common_bits_planar(x_l, t_l)                        # [W,a]
+            prefix_len = jnp.clip(b + 1, 0, ID_BITS)
             lo, ub = _prefix_block_bounds(lower, n, tgt[:, None, :]
                                           .repeat(x_rows.shape[1], 1),
                                           prefix_len)
