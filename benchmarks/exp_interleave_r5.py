@@ -1,0 +1,173 @@
+"""Wave double-buffering experiment (ask 1 follow-on).
+
+The round body is a serial chain of issue-bound gathers feeding
+VPU-bound sorts; advancing two INDEPENDENT half-waves inside ONE loop
+body gives XLA freedom to overlap one wave's gathers with the other's
+sorts (two separate while-ops would serialize).  Measures a fixed
+10-round loop at width 2W vs the same loop advancing two W-wide
+states, equal total work.
+
+NEGATIVE RESULT (v5e, N=10M, 2W=65536, measured 2026-08-01): single
+148.3 ms vs pair 157.1 ms — XLA's static TPU schedule serializes the
+two independent streams rather than overlapping gather with sort, and
+the split only loses batch efficiency.  Double-buffering waves is not
+a lever on this hardware; recorded so it isn't retried.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from bench import chain_slope
+    from opendht_tpu.ops.ids import N_LIMBS, clz32
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits)
+    from opendht_tpu.core import search as SE
+
+    _U32 = jnp.uint32
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = 10_000_000 if on_accel else 100_000
+    W = 32_768 if on_accel else 512            # half width (single = 2W)
+    NL, ALPHA, S, K = 2, 3, 14, 8
+    R = ALPHA * K
+    ROUNDS = 10
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    targets = jax.random.bits(k2, (2 * W, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+    n = jnp.asarray(n_valid, jnp.int32)
+
+    def make_wave(halves):
+        def wave(targets, sorted_ids, lut):
+            sorted_t = sorted_ids.T
+
+            def gather_planar(rows, limbs=N_LIMBS):
+                cl = jnp.clip(rows, 0, N - 1).reshape(-1)
+                g = jnp.take(sorted_t[:limbs], cl, axis=1)
+                return [g[l].reshape(rows.shape) for l in range(limbs)]
+
+            def block_bounds(t0, L):
+                return SE._lut_block_bounds(lut, t0, L)
+
+            def reply_gather(tgt, qidx, x_rows, round_no, seed_u):
+                x0 = gather_planar(x_rows, 1)[0]
+                b = clz32(x0 ^ tgt[:, 0:1])
+                lo, ub = block_bounds(tgt[:, 0:1], b + 1)
+                size = jnp.maximum(ub - lo, 0)
+                qi = qidx.astype(_U32)[:, None, None]
+                ai = jnp.arange(ALPHA, dtype=_U32)[None, :, None]
+                ji = jnp.arange(K, dtype=_U32)[None, None, :]
+                ctr = (((round_no.astype(_U32) * _U32(tgt.shape[0]) + qi)
+                        * _U32(ALPHA) + ai) * _U32(K) + ji) ^ seed_u
+                h = SE._mix32(ctr)
+                blk = lo[..., None] + (
+                    h % jnp.maximum(size[..., None], 1).astype(_U32)
+                ).astype(jnp.int32)
+                rows = jnp.where((size[..., None] >= K), blk, 0)
+                rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
+                return rows.reshape(tgt.shape[0], R)
+
+            def merge(tgt, cand_node, cand_l, queried, new_rows):
+                Wd = tgt.shape[0]
+                new_l = gather_planar(new_rows, NL)
+                node = jnp.concatenate([cand_node, new_rows], axis=1)
+                d_l = [jnp.concatenate(
+                    [cand_l[l], new_l[l] ^ tgt[:, l:l + 1]], axis=1)
+                    for l in range(NL)]
+                qd = jnp.concatenate(
+                    [queried, jnp.zeros((Wd, R), jnp.int32)], axis=1)
+                inv = (node < 0).astype(jnp.int32)
+                big = jnp.uint32(0xFFFFFFFF)
+                d_l = [jnp.where(inv == 0, dl, big) for dl in d_l]
+                out = lax.sort((inv,) + tuple(d_l) + (node, 1 - qd),
+                               dimension=1, num_keys=3 + NL)
+                node_s = out[1 + NL]
+                dup = jnp.concatenate(
+                    [jnp.zeros((Wd, 1), bool),
+                     (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)],
+                    axis=1)
+                inv2 = jnp.where(dup, 1, out[0])
+                out2 = lax.sort(
+                    (inv2,) + tuple(out[1:1 + NL]) + (node_s, out[2 + NL]),
+                    dimension=1, num_keys=2 + NL)
+                present = out2[0][:, :S] == 0
+                node_f = jnp.where(present, out2[1 + NL][:, :S], -1)
+                d_f = [jnp.where(present, out2[1 + l][:, :S], big)
+                       for l in range(NL)]
+                qd_f = (1 - out2[2 + NL])[:, :S] * present
+                return node_f, d_f, qd_f
+
+            def init_state(tgt, seed_u):
+                Q = tgt.shape[0]
+                qidx = jnp.arange(Q, dtype=jnp.int32)
+                boot = jnp.full((Q, ALPHA), -1, jnp.int32).at[:, 0].set(
+                    (SE._mix32(qidx.astype(_U32) ^ seed_u)
+                     % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32))
+                cand = jnp.full((Q, S), -1, jnp.int32)
+                cl = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(NL)]
+                qd = jnp.zeros((Q, S), jnp.int32)
+                first = reply_gather(tgt, qidx, boot, jnp.int32(0), seed_u)
+                return merge(tgt, cand, cl, qd, first) + (qidx, seed_u)
+
+            def advance(tgt, st, rnd):
+                cand, cl, qd, qidx, seed_u = st
+                can = (cand >= 0) & (qd == 0)
+                rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+                sel = can & (rank <= ALPHA)
+                x_rows = jnp.stack(
+                    [jnp.max(jnp.where(sel & (rank == j + 1), cand, -1),
+                             axis=1) for j in range(ALPHA)], axis=1)
+                new_rows = reply_gather(tgt, qidx, x_rows, rnd + 1, seed_u)
+                qd = jnp.where(sel, 1, qd)
+                cand, cl, qd = merge(tgt, cand, cl, qd, new_rows)
+                return (cand, cl, qd, qidx, seed_u)
+
+            if halves == 1:
+                st = init_state(targets, _U32(1))
+
+                def body(rnd, st):
+                    return advance(targets, st, rnd)
+
+                st = lax.fori_loop(0, ROUNDS, body, st)
+                return jnp.sum(st[0][:, :K].astype(jnp.float32)) * 1e-9
+            ta, tb = targets[:W], targets[W:]
+            sa = init_state(ta, _U32(1))
+            sb = init_state(tb, _U32(2))
+
+            def body(rnd, st):
+                sa, sb = st
+                return (advance(ta, sa, rnd), advance(tb, sb, rnd))
+
+            sa, sb = lax.fori_loop(0, ROUNDS, body, (sa, sb))
+            return (jnp.sum(sa[0][:, :K].astype(jnp.float32))
+                    + jnp.sum(sb[0][:, :K].astype(jnp.float32))) * 1e-9
+        return wave
+
+    for name, halves in (("single 2W=%d" % (2 * W), 1),
+                         ("pair 2x W=%d one loop" % W, 2)):
+        dt = chain_slope(make_wave(halves), targets, sorted_ids, lut,
+                         r1=1, r2=4)
+        print(json.dumps({"stage": name, "ms": round(dt * 1e3, 2),
+                          "per_round_ms": round(dt * 1e3 / ROUNDS, 2),
+                          "lookups_per_s": round(2 * W / dt, 1)}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

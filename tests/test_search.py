@@ -213,3 +213,47 @@ def test_survivor_compaction_bitwise_identical():
     for key in ("nodes", "hops", "converged", "dist"):
         np.testing.assert_array_equal(np.asarray(out3[key]),
                                       np.asarray(ref[key]))
+
+
+def test_lut_block_bounds_exact_up_to_lut_width():
+    """_lut_block_bounds must equal the exact prefix-block edges for any
+    prefix length <= the LUT width — on clustered tables too (the
+    exactness claim is structural, not probabilistic: lut[p] counts
+    rows below prefix p) — and clamp to the containing bucket beyond
+    the width."""
+    import numpy as np
+    import jax.numpy as jnp
+    from opendht_tpu.ops.sorted_table import sort_table, build_prefix_lut
+    from opendht_tpu.core.search import _lut_block_bounds
+
+    rng = np.random.default_rng(55)
+    for cluster in (False, True):
+        raw = rng.integers(0, 2**32, size=(4096, 5), dtype=np.uint32)
+        if cluster:
+            raw[:3000, 0] = raw[0, 0]          # one giant top-32 cluster
+        s, _p, nv = sort_table(jnp.asarray(raw))
+        bits = 16
+        lut = build_prefix_lut(s, nv, bits=bits)
+        s_np = np.asarray(s)
+        top = s_np[:, 0]
+        t0 = rng.integers(0, 2**32, size=64, dtype=np.uint32)
+        t0[:8] = s_np[:: 512, 0][:8]           # hit real prefixes too
+        for L in (0, 1, 7, bits - 1, bits, bits + 3, 40, 160):
+            Lc = min(L, bits)
+            lo, ub = _lut_block_bounds(
+                lut, jnp.asarray(t0), jnp.full((64,), L, jnp.int32))
+            lo, ub = np.asarray(lo), np.asarray(ub)
+            # oracle: count rows whose top-Lc bits match the target's
+            shift = np.uint32(32 - Lc) if Lc else None
+            for i in range(64):
+                if Lc == 0:
+                    want_lo, want_ub = 0, int(nv)
+                else:
+                    pfx = t0[i] >> shift
+                    rows = top >> shift
+                    want_lo = int(np.searchsorted(rows, pfx, side="left"))
+                    want_ub = int(np.searchsorted(rows, pfx, side="right"))
+                    want_ub = min(want_ub, int(nv))
+                    want_lo = min(want_lo, int(nv))
+                assert lo[i] == want_lo and ub[i] == want_ub, \
+                    (cluster, L, i, lo[i], ub[i], want_lo, want_ub)
