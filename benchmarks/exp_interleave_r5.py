@@ -12,6 +12,11 @@ NEGATIVE RESULT (v5e, N=10M, 2W=65536, measured 2026-08-01): single
 two independent streams rather than overlapping gather with sort, and
 the split only loses batch efficiency.  Double-buffering waves is not
 a lever on this hardware; recorded so it isn't retried.
+
+The round body below is a deliberate FROZEN COPY of the engine state
+machine as measured — do not sync it with later core/search.py
+changes; the recorded numbers correspond to exactly this body (same
+policy as exp_round_r5.py).
 """
 
 from __future__ import annotations

@@ -48,7 +48,11 @@ class ClusterSubProcess:
     """Parent-side handle: spawn, RPC, and (ungracefully) kill a child
     process hosting a whole cluster of live UDP DHT nodes."""
 
-    def __init__(self, n_nodes: int = 0, *, timeout: float = 60.0):
+    def __init__(self, n_nodes: int = 0, *, timeout: float = 60.0,
+                 argv_prefix: tuple = ()):
+        """``argv_prefix``: argv prepended to the child command — e.g.
+        ``("ip", "netns", "exec", ns)`` runs the whole cluster inside a
+        network namespace (the real-kernel tier, testing/netns_net.py)."""
         self.timeout = timeout
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -64,7 +68,7 @@ class ClusterSubProcess:
                 "from opendht_tpu.testing.subproc_cluster import _child_main; "
                 "sys.exit(_child_main())")
         self.proc = subprocess.Popen(
-            [sys.executable, "-c", boot],
+            [*argv_prefix, sys.executable, "-c", boot],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, env=env)
         self._unpacker = msgpack.Unpacker(raw=True)

@@ -1,0 +1,80 @@
+"""Real-kernel tier: DHT traffic across Linux network namespaces.
+
+Closes the round-4 "real-kernel network tier" gap to the extent this
+kernel allows: two cluster subprocesses in separate namespaces, a seed
+node in the root namespace, IP forwarding between cluster subnets —
+a put in one namespace is read from the other, every packet crossing
+two real veth devices and the kernel forwarding path (reference
+topology: python/tools/dht/virtual_network_builder.py).  Loss/delay
+shaping stays environment-blocked (no sch_netem in this kernel) and is
+probed, not assumed.
+"""
+
+import secrets
+
+import pytest
+
+from opendht_tpu.testing.netns_net import (NetnsClusterNet, netem_available,
+                                           netns_available)
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not netns_available(),
+                       reason="netns/veth not available on this kernel"),
+]
+
+
+def test_put_get_across_real_kernel_namespaces():
+    from opendht_tpu import DhtRunner
+
+    seed = DhtRunner()
+    seed.run(0)                       # binds 0.0.0.0 → reachable on
+    port = seed.get_bound_port()      # every veth gateway address
+    net = NetnsClusterNet()
+    seed_alive = True
+    try:
+        a = net.add_cluster(4)
+        b = net.add_cluster(4)
+        a.bootstrap(net.gateway_addr(0), port)
+        b.bootstrap(net.gateway_addr(1), port)
+
+        key = secrets.token_bytes(20)
+        payload = b"netns-tier-" + secrets.token_hex(8).encode()
+        assert a.put(key, payload)
+        vals = b.get(key)
+        assert payload in vals, (vals, "cross-namespace get missed")
+
+        # Now FORCE the forwarded a<->b path: with the root-namespace
+        # seed gone, a second put/get can only succeed if cluster-b
+        # nodes reach cluster-a nodes directly across the two veth
+        # subnets through kernel forwarding (8 cluster nodes > the
+        # seedless minimum; the first round-trip above warmed the
+        # cross-cluster routing tables).
+        seed.shutdown()
+        seed.join()
+        seed_alive = False
+        key2 = secrets.token_bytes(20)
+        payload2 = b"netns-fwd-" + secrets.token_hex(8).encode()
+        assert a.put(key2, payload2)
+        vals2 = b.get(key2)
+        assert payload2 in vals2, \
+            (vals2, "cross-cluster forwarding path not exercised")
+
+        # the clusters really live on distinct kernel subnets
+        assert net.cluster_addr(0) != net.cluster_addr(1)
+    finally:
+        net.close()
+        if seed_alive:
+            seed.shutdown()
+            seed.join()
+
+
+def test_netem_probe_is_recorded():
+    """The loss/delay half of the reference tier needs sch_netem; this
+    probe documents the environment bound rather than silently skipping
+    (if the kernel ever gains netem, this test will flag that the tier
+    can now be extended)."""
+    assert netem_available() in (True, False)   # probe must not crash
+    if netem_available():
+        pytest.skip("netem IS available here — extend the tier with "
+                    "loss/delay shaping (see netns_net.py docstring)")
