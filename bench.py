@@ -375,8 +375,11 @@ def profile(N: int = None, Q: int = None) -> list:
     sorted_ids, perm, n_valid = jax.block_until_ready(sort_table(table))
     lut = jax.block_until_ready(
         build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
-    exp64 = jax.block_until_ready(expand_table(sorted_ids, stride=64))
-    exp32 = jax.block_until_ready(expand_table(sorted_ids, stride=32))
+    # 2-plane expansions — the shipped headline geometry (round 5)
+    exp64 = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
+    exp32 = jax.block_until_ready(
+        expand_table(sorted_ids, stride=32, limbs=2))
+    exp32_5 = jax.block_until_ready(expand_table(sorted_ids, stride=32))
 
     out = []
 
@@ -414,26 +417,29 @@ def profile(N: int = None, Q: int = None) -> list:
     stage("pos0 + row gather s=32", gather_body(32),
           sorted_ids, n_valid, lut, exp32)
 
-    def full_body(select, steps):
+    def full_body(select, steps, planes):
         def body(q, sorted_ids, expanded, n_valid, lut):
             d, idx, c = expanded_topk(sorted_ids, expanded, n_valid, q, k=K,
                                       select=select, lut=lut,
-                                      lut_steps=steps)
+                                      lut_steps=steps, planes=planes)
             return (jnp.sum(c.astype(jnp.float32))
                     + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
         return body
 
-    for name, expd, steps, select in [
-        ("full fast2 s=64 steps=6 (r2-era geometry)", exp64, 6, "fast2"),
-        ("full fast2 s=64 steps=0", exp64, 0, "fast2"),
-        ("full fast2 s=32 steps=6", exp32, 6, "fast2"),
-        ("full fast2 s=32 steps=0", exp32, 0, "fast2"),
-        ("full fast3 s=32 steps=0", exp32, 0, "fast3"),
+    for name, expd, steps, select, planes in [
+        ("full fast2 s=64 steps=0 planes=2", exp64, 0, "fast2", 2),
+        ("full fast2 s=32 steps=6 planes=2", exp32, 6, "fast2", 2),
+        ("full fast2 s=32 steps=0 planes=2", exp32, 0, "fast2", 2),
+        ("full fast2 s=32 steps=0 planes=5 (pre-r5)", exp32_5, 0,
+         "fast2", 5),
+        ("full fast3 s=32 steps=0", exp32_5, 0, "fast3", 5),
     ]:
-        stage(name, full_body(select, steps), sorted_ids, expd, n_valid, lut)
+        stage(name, full_body(select, steps, planes), sorted_ids, expd,
+              n_valid, lut)
         _, _, c = jax.block_until_ready(
             expanded_topk(sorted_ids, expd, n_valid, queries, k=K,
-                          select=select, lut=lut, lut_steps=steps))
+                          select=select, lut=lut, lut_steps=steps,
+                          planes=planes))
         rec = {"stage": "certified fraction", "value":
                float(np.asarray(c).mean())}
         print(json.dumps(rec), flush=True)
@@ -442,7 +448,8 @@ def profile(N: int = None, Q: int = None) -> list:
     # the full headline pipeline (stage-1 fast path + on-device repair)
     def casc_body(q, sorted_ids, e32, e64, n_valid, lut):
         d, idx, c = cascade_topk(sorted_ids, e32, e64, n_valid, q, lut,
-                                 k=K, select="fast2", cap=HEADLINE_CAP)
+                                 k=K, select="fast2", cap=HEADLINE_CAP,
+                                 planes=2)
         return (jnp.sum(c.astype(jnp.float32))
                 + jnp.sum(idx[:, 0].astype(jnp.float32)) * 1e-9)
 

@@ -24,6 +24,7 @@ names are prefixed ``odt`` to avoid collisions.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import time
 from typing import List, Optional
@@ -38,25 +39,33 @@ def _sh(*argv, check: bool = True) -> subprocess.CompletedProcess:
                           check=check)
 
 
+def _uniq() -> str:
+    """Short per-process prefix: concurrent sessions must not race on
+    kernel object names (interface names cap at 15 chars)."""
+    return "odt%d" % (os.getpid() % 100000)
+
+
 def netns_available() -> bool:
     """True when namespaces + veth can actually be created here.
     Stale probe artifacts from a killed prior run are cleared first so
-    one crash can never permanently disable the tier."""
+    one crash can never permanently disable the tier; names are
+    per-process so concurrent sessions cannot corrupt each other."""
+    u = _uniq()
     try:
-        _sh("ip", "netns", "del", "__odt_probe", check=False)
-        _sh("ip", "link", "del", "__odt_p0", check=False)
-        _sh("ip", "netns", "add", "__odt_probe")
+        _sh("ip", "netns", "del", u + "pr", check=False)
+        _sh("ip", "link", "del", u + "p0", check=False)
+        _sh("ip", "netns", "add", u + "pr")
     except (OSError, subprocess.CalledProcessError):
         return False
     try:
-        _sh("ip", "link", "add", "__odt_p0", "type", "veth",
-            "peer", "name", "__odt_p1")
-        _sh("ip", "link", "del", "__odt_p0")
+        _sh("ip", "link", "add", u + "p0", "type", "veth",
+            "peer", "name", u + "p1")
+        _sh("ip", "link", "del", u + "p0")
         return True
     except (OSError, subprocess.CalledProcessError):
         return False
     finally:
-        _sh("ip", "netns", "del", "__odt_probe", check=False)
+        _sh("ip", "netns", "del", u + "pr", check=False)
 
 
 def netem_available() -> bool:
@@ -64,20 +73,21 @@ def netem_available() -> bool:
     False on this build host — recorded as the environment bound.
     Must never raise: a missing ``tc`` binary (no iproute2-tc userland)
     is one of the exact environments this probe documents."""
+    u = _uniq()
     try:
-        _sh("ip", "link", "del", "__odt_q0", check=False)
-        _sh("ip", "link", "add", "__odt_q0", "type", "veth",
-            "peer", "name", "__odt_q1")
+        _sh("ip", "link", "del", u + "q0", check=False)
+        _sh("ip", "link", "add", u + "q0", "type", "veth",
+            "peer", "name", u + "q1")
     except (OSError, subprocess.CalledProcessError):
         return False
     try:
-        r = _sh("tc", "qdisc", "add", "dev", "__odt_q0", "root",
+        r = _sh("tc", "qdisc", "add", "dev", u + "q0", "root",
                 "netem", "delay", "1ms", check=False)
         return r.returncode == 0
     except OSError:                      # tc binary absent
         return False
     finally:
-        _sh("ip", "link", "del", "__odt_q0", check=False)
+        _sh("ip", "link", "del", u + "q0", check=False)
 
 
 class NetnsClusterNet:
@@ -99,12 +109,18 @@ class NetnsClusterNet:
         self.clusters: List[ClusterSubProcess] = []
         self._ns: List[str] = []
         self._links: List[str] = []
+        self._prefix = _uniq()
+        self._saved_ip_forward: Optional[str] = None
 
     def add_cluster(self, n_nodes: int, *, timeout: float = 120.0
                     ) -> ClusterSubProcess:
         i = len(self._ns)
-        ns, vh, vc = f"odtns{i}", f"odtv{i}h", f"odtv{i}c"
+        p = self._prefix
+        ns, vh, vc = f"{p}n{i}", f"{p}v{i}h", f"{p}v{i}c"
         sub = _SUBNET % i
+        # clear stale artifacts from a killed prior run of THIS pid slot
+        _sh("ip", "netns", "del", ns, check=False)
+        _sh("ip", "link", "del", vh, check=False)
         _sh("ip", "netns", "add", ns)
         self._ns.append(ns)
         _sh("ip", "link", "add", vh, "type", "veth", "peer", "name", vc)
@@ -120,17 +136,25 @@ class NetnsClusterNet:
         # forwarding is load-bearing for cross-cluster traffic: write
         # /proc directly (no sysctl-binary dependency) and VERIFY — a
         # silently-off forward would blackhole a<->b packets and
-        # surface later as an opaque lookup miss
-        try:
-            with open("/proc/sys/net/ipv4/ip_forward", "w") as f:
-                f.write("1")
-        except OSError:
-            pass
+        # surface later as an opaque lookup miss.  The prior value is
+        # saved once and restored in close(): flipping a host-global
+        # routing knob must not outlive the harness.
         with open("/proc/sys/net/ipv4/ip_forward") as f:
-            if f.read().strip() != "1":
-                raise RuntimeError(
-                    "cannot enable net.ipv4.ip_forward — cross-cluster "
-                    "routing unavailable in this container")
+            cur = f.read().strip()
+        if cur != "1":
+            if self._saved_ip_forward is None:
+                self._saved_ip_forward = cur
+            try:
+                with open("/proc/sys/net/ipv4/ip_forward", "w") as f:
+                    f.write("1")
+            except OSError:
+                pass
+            with open("/proc/sys/net/ipv4/ip_forward") as f:
+                if f.read().strip() != "1":
+                    raise RuntimeError(
+                        "cannot enable net.ipv4.ip_forward — "
+                        "cross-cluster routing unavailable in this "
+                        "container")
         cl = ClusterSubProcess(argv_prefix=("ip", "netns", "exec", ns),
                                timeout=timeout)
         self.clusters.append(cl)
@@ -159,6 +183,13 @@ class NetnsClusterNet:
             _sh("ip", "link", "del", vh, check=False)
         for ns in self._ns:
             _sh("ip", "netns", "del", ns, check=False)
+        if self._saved_ip_forward is not None:
+            try:
+                with open("/proc/sys/net/ipv4/ip_forward", "w") as f:
+                    f.write(self._saved_ip_forward)
+            except OSError:
+                pass
+            self._saved_ip_forward = None
         self.clusters.clear()
         self._ns.clear()
         self._links.clear()

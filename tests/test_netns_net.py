@@ -74,7 +74,8 @@ def test_netem_probe_is_recorded():
     probe documents the environment bound rather than silently skipping
     (if the kernel ever gains netem, this test will flag that the tier
     can now be extended)."""
-    assert netem_available() in (True, False)   # probe must not crash
-    if netem_available():
+    avail = netem_available()                   # probe must not crash
+    assert avail in (True, False)
+    if avail:
         pytest.skip("netem IS available here — extend the tier with "
                     "loss/delay shaping (see netns_net.py docstring)")
