@@ -31,7 +31,6 @@ unfrozen and aimed at a socket.
 from __future__ import annotations
 
 import argparse
-import os
 import secrets
 import select
 import socket

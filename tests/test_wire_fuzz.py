@@ -22,7 +22,7 @@ import pytest
 
 from opendht_tpu.core.value import MAX_VALUE_SIZE, Value
 from opendht_tpu.infohash import InfoHash
-from opendht_tpu.net import EngineCallbacks, NetworkEngine, ParsedMessage
+from opendht_tpu.net import EngineCallbacks, NetworkEngine
 from opendht_tpu.net.engine import RX_MAX_PACKET_TIME
 from opendht_tpu.net.parsed_message import pack_tid
 from opendht_tpu.scheduler import Scheduler
