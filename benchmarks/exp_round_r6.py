@@ -1,0 +1,341 @@
+"""Round-body cost attribution for the ROUND-FUSED iterative engine
+(round 6 tentpole) + the CI wave-latency smoke.
+
+Same fixed-trip methodology as exp_round_r5.py: each variant runs the
+REAL round body in a fixed ``ROUNDS``-trip ``fori_loop`` (no
+convergence exit) with one piece disabled, so (full − variant)
+attributes cost inside the real compiled loop, fusion effects
+included.  The body here mirrors the ROUND-6 engine
+(core/search.py): the reply blocks are positioned from the CARRIED
+candidate distance limb (no per-round peer gather), both LUT block
+edges ride one stacked take, and the round's only table gather is the
+fused [W·α·k] reply fetch.  The ``r5_unfused`` variant re-enables the
+round-5 per-round peer gather + split LUT reads inside the same loop,
+so (r5_unfused − fused) is the measured fusion win at this shape.
+
+Like exp_round_r5.py, the round body here is a MIRROR of the engine's,
+maintained by hand so pieces can be disabled — it is NOT
+core/search.py's own code.  What pins the SHIPPING engine is the
+committed reply-stream goldens (tests/test_search.py::
+test_engine_reply_stream_goldens, run by the CI suite before this
+driver); this file's claims are about the mirrored body, and an engine
+edit that changes the round structure must be ported here for the
+attribution to stay meaningful (the goldens catch output drift, this
+note is what covers attribution drift).
+
+``--smoke`` (the ci/run_ci.sh wave-latency entry) additionally asserts
+
+  1. the mirrored fused and r5_unfused round bodies produce
+     BIT-IDENTICAL final search states end-to-end through the
+     compiled loop — the fusion-equivalence argument, demonstrated on
+     the same body the attribution numbers come from;
+  2. the fused round is not slower than the unfused round by more
+     than a generous 1.5× band — a p50 wave-latency regression on the
+     fused path fails CI without running the full bench.
+
+The per-stage numbers printed by a full run are the inputs to the
+wave-latency ARCHITECTURAL BOUND recorded in README/PARITY: the fused
+round's serial chain is one fused reply gather + one stacked LUT read
++ two (S+R)-wide merge sorts + dispatch residue, and a wave's p50
+completion is bounded below by rounds × that floor.  Exploration tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANTS = ("fused", "r5_unfused", "no_reply_gather", "no_lut_reads",
+            "no_dedup_sort", "no_alpha_select")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="small-shape CI smoke: bit-identity + regression "
+                        "band only")
+    p.add_argument("-N", type=int, default=0, help="table rows")
+    p.add_argument("-W", type=int, default=0, help="wave width")
+    p.add_argument("--rounds", type=int, default=10)
+    p.add_argument("--capture", default="",
+                   help="write captures/<name>.json with the attribution")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from bench import chain_slope
+    from opendht_tpu.ops.ids import N_LIMBS, clz32
+    from opendht_tpu.ops.sorted_table import (sort_table, build_prefix_lut,
+                                              default_lut_bits, _lut_bits,
+                                              fused_gather_planar)
+    from opendht_tpu.core import search as SE
+
+    _U32 = jnp.uint32
+    on_accel = jax.devices()[0].platform != "cpu"
+    if args.smoke:
+        N = args.N or 65_536
+        W = args.W or 1_024
+    else:
+        N = args.N or (10_000_000 if on_accel else 262_144)
+        W = args.W or (16_384 if on_accel else 1_024)
+    NL, ALPHA, S, K = 2, 3, 14, 8
+    R = ALPHA * K
+    ROUNDS = args.rounds
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    targets0 = jax.random.bits(k2, (W, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+    n = jnp.asarray(n_valid, jnp.int32)
+
+    def split_lut_block_bounds(lut, t0, prefix_len):
+        """The ROUND-5 form: two separate LUT takes per edge pair."""
+        bits = _lut_bits(lut)
+        Lc = jnp.clip(prefix_len, 0, bits)
+        shift = (jnp.int32(bits) - Lc).astype(_U32)
+        top = (t0 >> _U32(32 - bits)).astype(_U32)
+        pfx = (top >> shift) << shift
+        lo = jnp.take(lut, pfx.astype(jnp.int32))
+        ub = jnp.take(lut, (pfx + (_U32(1) << shift)).astype(jnp.int32))
+        return lo, ub
+
+    def make_wave(variant, return_state=False):
+        def wave(targets, sorted_ids, lut):
+            lower = SE._guarded_lower_bound(sorted_ids, n, lut)
+            sorted_t = sorted_ids.T
+
+            def gather_planar(rows, limbs=N_LIMBS):
+                return fused_gather_planar(sorted_t, rows, limbs)
+
+            Q = targets.shape[0]
+            seed_u = _U32(1)
+            q_index = jnp.arange(Q, dtype=jnp.int32)
+            pos_t_full = lower(targets)
+
+            def reply_gather(tgt, pt, qidx, x_rows, round_no, x_d0):
+                Wd = tgt.shape[0]
+                if variant == "r5_unfused" or x_d0 is None:
+                    x0 = gather_planar(x_rows, 1)[0]
+                    x_d0 = x0 ^ tgt[:, 0:1]
+                b = clz32(x_d0)
+                if variant == "no_lut_reads":
+                    lo = jnp.zeros_like(b)
+                    ub = jnp.full_like(b, jnp.int32(1) << 20)
+                elif variant == "r5_unfused":
+                    lo, ub = split_lut_block_bounds(lut, tgt[:, 0:1], b + 1)
+                else:
+                    lo, ub = SE._lut_block_bounds(lut, tgt[:, 0:1], b + 1)
+                size = jnp.maximum(ub - lo, 0)
+                qi = qidx.astype(_U32)[:, None, None]
+                ai = jnp.arange(x_rows.shape[1], dtype=_U32)[None, :, None]
+                ji = jnp.arange(K, dtype=_U32)[None, None, :]
+                ctr = (((round_no.astype(_U32) * _U32(Q) + qi) * _U32(ALPHA)
+                        + ai) * _U32(K) + ji) ^ seed_u
+                h = SE._mix32(ctr)
+                blk = lo[..., None] + (
+                    h % jnp.maximum(size[..., None], 1).astype(_U32)
+                ).astype(jnp.int32)
+                base = jnp.clip(pt[:, None, None] - R // 2, 0,
+                                jnp.maximum(n - R, 0))
+                fb = jnp.clip(base + (ai * _U32(K) + ji).astype(jnp.int32),
+                              0, jnp.maximum(n - 1, 0))
+                rows = jnp.where((size[..., None] >= K), blk, fb)
+                rows = jnp.where((x_rows >= 0)[..., None], rows, -1)
+                return rows.reshape(Wd, R)
+
+            def merge(tgt, cand_node, cand_l, queried, new_rows):
+                Wd = tgt.shape[0]
+                if variant == "no_reply_gather":
+                    new_l = [jnp.zeros((Wd, R), _U32) for _ in range(NL)]
+                else:
+                    new_l = gather_planar(new_rows, NL)
+                node = jnp.concatenate([cand_node, new_rows], axis=1)
+                d_l = [jnp.concatenate(
+                    [cand_l[l], new_l[l] ^ tgt[:, l:l + 1]], axis=1)
+                    for l in range(NL)]
+                qd = jnp.concatenate([queried,
+                                      jnp.zeros((Wd, R), jnp.int32)], axis=1)
+                inv = (node < 0).astype(jnp.int32)
+                big = jnp.uint32(0xFFFFFFFF)
+                d_l = [jnp.where(inv == 0, dl, big) for dl in d_l]
+                out = lax.sort((inv,) + tuple(d_l) + (node, 1 - qd),
+                               dimension=1, num_keys=3 + NL)
+                inv_s, node_s = out[0], out[1 + NL]
+                qd_s = 1 - out[2 + NL]
+                if variant == "no_dedup_sort":
+                    present = inv_s[:, :S] == 0
+                    node_f = jnp.where(present, node_s[:, :S], -1)
+                    d_f = [jnp.where(present, out[1 + l][:, :S], big)
+                           for l in range(NL)]
+                    qd_f = qd_s[:, :S] * present
+                    return node_f, d_f, qd_f
+                dup = jnp.concatenate(
+                    [jnp.zeros((Wd, 1), bool),
+                     (node_s[:, 1:] == node_s[:, :-1]) & (node_s[:, 1:] >= 0)],
+                    axis=1)
+                inv2 = jnp.where(dup, 1, inv_s)
+                out2 = lax.sort(
+                    (inv2,) + tuple(out[1:1 + NL]) + (node_s, 1 - qd_s),
+                    dimension=1, num_keys=2 + NL)
+                present = out2[0][:, :S] == 0
+                node_f = jnp.where(present, out2[1 + NL][:, :S], -1)
+                d_f = [jnp.where(present, out2[1 + l][:, :S], big)
+                       for l in range(NL)]
+                qd_f = (1 - out2[2 + NL])[:, :S] * present
+                return node_f, d_f, qd_f
+
+            boot = jnp.full((Q, ALPHA), -1, jnp.int32).at[:, 0].set(
+                (SE._mix32(q_index.astype(_U32) ^ seed_u)
+                 % jnp.maximum(n, 1).astype(_U32)).astype(jnp.int32))
+            cand_node = jnp.full((Q, S), -1, jnp.int32)
+            cand_l = [jnp.full((Q, S), 0xFFFFFFFF, _U32) for _ in range(NL)]
+            queried = jnp.zeros((Q, S), jnp.int32)
+            first = reply_gather(targets, pos_t_full, q_index, boot,
+                                 jnp.int32(0), None)
+            cand_node, cand_l, queried = merge(targets, cand_node, cand_l,
+                                               queried, first)
+
+            def body(rnd, state):
+                cand_node, cand_l, queried = state
+                can = (cand_node >= 0) & (queried == 0)
+                rank = jnp.cumsum(can.astype(jnp.int32), axis=1)
+                sel = can & (rank <= ALPHA)
+                if variant == "no_alpha_select":
+                    x_rows = cand_node[:, :ALPHA]
+                    x_d0 = cand_l[0][:, :ALPHA]
+                else:
+                    x_rows = jnp.stack(
+                        [jnp.max(jnp.where(sel & (rank == j + 1),
+                                           cand_node, -1), axis=1)
+                         for j in range(ALPHA)], axis=1)
+                    # the round-6 fusion: d0 rides the same reductions
+                    x_d0 = jnp.stack(
+                        [jnp.max(jnp.where(sel & (rank == j + 1),
+                                           cand_l[0], _U32(0)), axis=1)
+                         for j in range(ALPHA)], axis=1)
+                new_rows = reply_gather(targets, pos_t_full, q_index,
+                                        x_rows, rnd + 1, x_d0)
+                queried = jnp.where(sel, 1, queried)
+                cand_node, cand_l, queried = merge(
+                    targets, cand_node, cand_l, queried, new_rows)
+                return cand_node, cand_l, queried
+
+            cand_node, cand_l, queried = lax.fori_loop(
+                0, ROUNDS, body, (cand_node, cand_l, queried))
+            if return_state:
+                return cand_node, cand_l, queried
+            return (jnp.sum(cand_node[:, :K].astype(jnp.float32)) * 1e-9
+                    + jnp.sum(queried.astype(jnp.float32)) * 1e-9)
+        return wave
+
+    if args.smoke:
+        # 1) end-to-end bit-identity of the fusion through the loop
+        st_f = jax.jit(make_wave("fused", return_state=True))(
+            targets0, sorted_ids, lut)
+        st_u = jax.jit(make_wave("r5_unfused", return_state=True))(
+            targets0, sorted_ids, lut)
+        for a, b, name in ((st_f[0], st_u[0], "cand_node"),
+                           (st_f[2], st_u[2], "queried"),
+                           *((x, y, f"cand_l{i}") for i, (x, y)
+                             in enumerate(zip(st_f[1], st_u[1])))):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                print(f"SMOKE FAIL: fused vs r5_unfused diverge on {name}")
+                return 1
+        # 2) regression band on the fused round.  The two chains sit at
+        # near-parity by design, so host-load stalls are the flake
+        # risk: each variant is measured twice (same compiled chain —
+        # chain_slope caches per body) and the band compares the MIN
+        # of each pair, which filters a one-sided scheduling stall
+        # while a real code regression shifts every sample.
+        r1, r2 = (2, 8)
+        wf, wu = make_wave("fused"), make_wave("r5_unfused")
+        dts_f = [chain_slope(wf, targets0, sorted_ids, lut, r1=r1, r2=r2)
+                 for _ in range(2)]
+        dts_u = [chain_slope(wu, targets0, sorted_ids, lut, r1=r1, r2=r2)
+                 for _ in range(2)]
+        dt_f, dt_u = min(dts_f), min(dts_u)
+        rec = {"smoke": True, "N": N, "W": W, "rounds": ROUNDS,
+               "fused_ms_per_round": round(dt_f * 1e3 / ROUNDS, 3),
+               "r5_unfused_ms_per_round": round(dt_u * 1e3 / ROUNDS, 3),
+               "samples_ms": [round(d * 1e3, 2) for d in dts_f + dts_u],
+               "bit_identical": True}
+        print(json.dumps(rec), flush=True)
+        if dt_f > 1.5 * dt_u:
+            print(f"SMOKE FAIL: fused round {dt_f * 1e3:.2f} ms > "
+                  f"1.5x unfused {dt_u * 1e3:.2f} ms (min of 2 each)")
+            return 1
+        print("wave-latency smoke ok")
+        return 0
+
+    base = None
+    recs = []
+    for v in VARIANTS:
+        dt = chain_slope(make_wave(v), targets0, sorted_ids, lut, r1=1, r2=4)
+        rec = {"variant": v, "ms": round(dt * 1e3, 2),
+               "ms_per_round": round(dt * 1e3 / ROUNDS, 3)}
+        if v == "fused":
+            base = dt
+        elif base:
+            rec["saves_ms"] = round((base - dt) * 1e3, 2)
+        recs.append(rec)
+        print(json.dumps(rec), flush=True)
+    by = {r["variant"]: r for r in recs}
+    bound = {
+        "platform": jax.devices()[0].platform,
+        "N": N, "W": W, "rounds": ROUNDS,
+        "round_floor_ms": by["fused"]["ms_per_round"],
+        "wave_bound_ms": round(by["fused"]["ms_per_round"] * ROUNDS, 2),
+        # a stage's per-round cost = how much the wave SPEEDS UP with it
+        # disabled (saves_ms / rounds); negative values are measurement
+        # noise on stages at the dispatch floor
+        "stage_ms_per_round": {
+            "reply_gather": round(by["no_reply_gather"].get("saves_ms", 0)
+                                  / ROUNDS, 3),
+            "lut_reads": round(by["no_lut_reads"].get("saves_ms", 0)
+                               / ROUNDS, 3),
+            "dedup_sort": round(by["no_dedup_sort"].get("saves_ms", 0)
+                                / ROUNDS, 3),
+            "alpha_select": round(by["no_alpha_select"].get("saves_ms", 0)
+                                  / ROUNDS, 3),
+            "r5_peer_gather_removed": round(
+                (by["r5_unfused"]["ms"] - by["fused"]["ms"]) / ROUNDS, 3),
+        },
+    }
+    print(json.dumps({"bound": bound}), flush=True)
+    if args.capture:
+        out = {
+            "metric": ("round-fused engine attribution, fixed-trip "
+                       "%d-round fori_loop, W=%d x N=%d, alpha=%d k=%d "
+                       "state_limbs=%d, platform=%s; per-variant ms and "
+                       "the per-round floor the wave-latency bound "
+                       "quotes (wave p50 >= rounds x floor)"
+                       % (ROUNDS, W, N, ALPHA, K, NL,
+                          jax.devices()[0].platform)),
+            "value": by["fused"]["ms_per_round"],
+            "unit": "ms/round (%s)" % jax.devices()[0].platform,
+            "vs_baseline": None,
+            "variants": recs,
+            "bound": bound,
+        }
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "captures",
+            args.capture + ".json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        print(f"capture written: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,24 @@ spec.loader.exec_module(m)
 for c in (1, 3, 4, 5, 6):
     m.main(["-c", str(c)])
 PY
+# wave-latency smoke (round 6): the fixed-trip round-attribution driver
+# at a small wave asserts (1) the driver's MIRROR of the round-fused
+# engine body is bit-identical to its round-5 unfused form through the
+# compiled loop (the SHIPPING engine's reply streams are pinned by the
+# goldens test in the suite above) and (2) the fused round has not
+# regressed past a generous 1.5x band — p50 wave-latency regressions on
+# the fused path fail here without the full bench.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib
+spec = importlib.util.spec_from_file_location(
+    "exp_round_r6", pathlib.Path("benchmarks/exp_round_r6.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "wave-latency smoke failed"
+PY
 # table-sharded iterative mode on a REAL 8-device virtual mesh.  The
 # heredoc (rather than env vars + the module CLI) is deliberate: on
 # hosts that register an accelerator backend via sitecustomize, the

@@ -29,15 +29,69 @@ __version__ = "0.1.0"
 from .infohash import InfoHash, PkId, random_infohash  # noqa: F401
 from .core.value import Value, ValueType, Query, Select, Where, Filters  # noqa: F401
 from .runtime.config import Config, NodeStats, NodeStatus, SecureDhtConfig  # noqa: F401
-from .runtime.runner import DhtRunner, RunnerConfig  # noqa: F401
-from .crypto import (  # noqa: F401
-    Certificate, Identity, PrivateKey, PublicKey, RevocationList, TrustList,
-    VerifyResult, generate_identity, generate_ec_identity,
-)
 from .sockaddr import SockAddr  # noqa: F401
 from .net.node import Node  # noqa: F401
 from .nodeset import NodeEntry, NodeSet  # noqa: F401
 from .indexation.pht import IndexEntry as IndexValue, Pht  # noqa: F401
+
+# The crypto-backed surface (DhtRunner + the identity/certificate types)
+# resolves LAZILY (PEP 562): it is the only part of the package that
+# needs the ``cryptography`` wheel, and an eager import here used to
+# poison every `import opendht_tpu` — including the pure device-kernel
+# paths (ops/, core/, parallel/) — on hosts without it.  With
+# ``cryptography`` installed nothing changes (first attribute access
+# imports and caches the real object); without it, only touching these
+# names raises, with the kernels and the sharded engine fully usable.
+_LAZY_EXPORTS = {
+    name: ".runtime.runner" for name in ("DhtRunner", "RunnerConfig")
+}
+_LAZY_EXPORTS.update({
+    name: ".crypto" for name in (
+        "Certificate", "Identity", "PrivateKey", "PublicKey",
+        "RevocationList", "TrustList", "VerifyResult",
+        "generate_identity", "generate_ec_identity",
+    )
+})
+
+
+def __getattr__(name):
+    mod = _LAZY_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    try:
+        value = getattr(importlib.import_module(mod, __name__), name)
+    except ModuleNotFoundError as e:
+        # AttributeError (chained from the real cause) — NOT the bare
+        # ModuleNotFoundError: hasattr()/dir()-driven introspection
+        # (help, pydoc, inspect.getmembers) must degrade softly on a
+        # crypto-less host, while `from opendht_tpu import DhtRunner`
+        # still raises ImportError (the import machinery converts the
+        # AttributeError) and direct access still names the missing
+        # wheel.
+        raise AttributeError(
+            f"opendht_tpu.{name} requires the optional '{e.name}' package "
+            f"(the device kernels, search engine, and parallel/ sharding "
+            f"work without it)") from e
+    globals()[name] = value              # cache: __getattr__ runs once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))
+
+
+# Star imports materialize every __all__ name, so on a host without
+# ``cryptography`` a `from opendht_tpu import *` raises — exactly what
+# the fully-eager module did; the laziness win is that plain
+# `import opendht_tpu` (and every non-crypto submodule) now works there.
+__all__ = [
+    "InfoHash", "PkId", "random_infohash",
+    "Value", "ValueType", "Query", "Select", "Where", "Filters",
+    "Config", "NodeStats", "NodeStatus", "SecureDhtConfig",
+    "SockAddr", "Node", "NodeEntry", "NodeSet", "IndexValue", "Pht",
+    "DhtConfig", "ListenToken",
+] + sorted(_LAZY_EXPORTS)
 
 #: binding-compat aliases (↔ python/opendht.pyx names)
 DhtConfig = Config

@@ -12,7 +12,15 @@ one device step selects the next α unqueried candidates for every one of
 Q searches, resolves all Q·α simulated replies against the global node
 matrix, and merges them back — all as fixed-shape array ops inside a
 ``lax.while_loop``.  A million lookups cost a few dozen fused device
-steps instead of millions of scalar iterations.
+steps instead of millions of scalar iterations.  As of round 6 the
+steady-state round is ROUND-FUSED: all α·k reply rows of the whole wave
+are fetched by ONE fused gather (``ops.sorted_table.fused_gather_planar``
+over a single [W·α·k] index vector), the reply blocks are positioned
+from the *carried* candidate distance limb instead of a per-round peer
+gather, and both LUT block edges ride one stacked read — so a round's
+serial chain is one gather + one LUT read + two merge sorts, the
+minimum issue structure the reply model admits (see PARITY.md for the
+measured wave-latency bound that follows).
 
 State layout (fixed shapes; "no candidate" = node index -1):
 
@@ -52,7 +60,7 @@ from ..ops.ids import N_LIMBS, ID_BITS, ids_to_bytes, clz32
 from ..ops.radix import _PREFIX_MASKS
 from ..ops.sorted_table import (_lex_lt, _lower_bound, _lut_bits,
                                 build_prefix_lut, default_lut_bits,
-                                lut_budget_steps)
+                                fused_gather_planar, lut_budget_steps)
 
 _U32 = jnp.uint32
 
@@ -137,9 +145,15 @@ def _lut_block_bounds(lut, t0, prefix_len):
     shift = (jnp.int32(bits) - Lc).astype(_U32)
     top = (t0 >> _U32(32 - bits)).astype(_U32)
     pfx = (top >> shift) << shift
-    lo = jnp.take(lut, pfx.astype(jnp.int32))
-    ub = jnp.take(lut, (pfx + (_U32(1) << shift)).astype(jnp.int32))
-    return lo, ub
+    # ONE stacked take for both edges: LUT reads are per-element
+    # issue-bound gathers like every other table access in the round,
+    # so what matters is the number of gather ops on the serial chain —
+    # fusing lo and ub into a single [2, ...] index vector halves it
+    # (and in the sharded twin the psum over the stacked pair is ONE
+    # collective per round instead of two — parallel/sharded.py).
+    edges = jnp.stack([pfx, pfx + (_U32(1) << shift)]).astype(jnp.int32)
+    g = jnp.take(lut, edges)
+    return g[0], g[1]
 
 
 def _guarded_lower_bound(sorted_ids, n, lut):
@@ -260,11 +274,23 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
       block_bounds(t0, prefix_len) -> (lo, ub) prefix-block edges
           (optional third primitive): t0 = targets' first limb
           (broadcastable against prefix_len).  When provided (the
-          :func:`_lut_block_bounds` fast path — two LUT reads), the
-          per-round positioning search disappears, which the round-body
-          attribution measured as 85% of the round; when None the
-          engine falls back to the exact search via ``lower``
-          (:func:`_prefix_block_bounds`).
+          :func:`_lut_block_bounds` fast path — one stacked LUT read
+          for both edges), the per-round positioning search disappears,
+          which the round-body attribution measured as 85% of the
+          round; when None the engine falls back to the exact search
+          via ``lower`` (:func:`_prefix_block_bounds`).
+
+    ROUND-FUSED GATHER (round 6): with ``block_bounds`` provided, the
+    steady-state round body issues exactly ONE ``gather_planar`` call —
+    the fused [W·α·k] reply-distance fetch inside the merge.  The
+    round-5 engine also gathered the α queried peers' top limb each
+    round (to position the reply blocks); that value is ``x0 ^ t0`` —
+    the very distance limb the candidate state already carries — so it
+    now rides the α-selection max-reductions instead (bit-identical;
+    tests/test_search.py pins the engine's outputs against committed
+    goldens so any reply-stream drift fails loudly).  In the
+    table-sharded twin the same change removes one of the per-round
+    psum sites (parallel/sharded.py).
 
     ``q_index``/``q_total`` are each query's GLOBAL index and the global
     batch size — the deterministic reply hash is seeded by global query
@@ -300,20 +326,32 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
 
     pos_t_full = lower(targets)                        # [Q], fallback replies
 
-    def reply_gather(tgt, pt, qidx, x_rows, round_no):
+    def reply_gather(tgt, pt, qidx, x_rows, round_no, x_d0=None):
         """Simulated answers of the α queried nodes per search.
-        x_rows [W, alpha] int32 (−1 = no request) → node rows [W, R]."""
+        x_rows [W, alpha] int32 (−1 = no request) → node rows [W, R].
+
+        ``x_d0``: the queried peers' top distance limb ``x0 ^ t0``
+        carried from the candidate state (the ROUND-FUSED form — see
+        the round body), or None to gather it from the table (the
+        bootstrap call, whose peer is not a candidate yet)."""
         W = tgt.shape[0]
         if block_bounds is not None:
             # 1-LIMB cb: the LUT block read clamps prefixes at its
             # ≤24-bit width, so any cb ≥ 32 yields the same clamped
             # edges — computing cb from limb 0 alone (exact below 32,
-            # 32 for deeper) is BIT-IDENTICAL through the LUT while the
-            # per-round x_l gather fetches 1 plane instead of 5 (the
-            # gathers are issue-bound — ~1 ms of the ~5.5 ms round at
-            # W=16K).  block_mode="exact" keeps the full-width path.
-            x0 = gather_planar(x_rows, 1)[0]
-            b = clz32(x0 ^ tgt[:, 0:1])          # clz32(0) == 32 by contract
+            # 32 for deeper) is BIT-IDENTICAL through the LUT.
+            # ROUND-FUSED GATHER (round 6): inside the loop x_d0 comes
+            # from the candidate state (cand_l[0] IS x0 ^ t0 — the
+            # merge computed it when the peer was first heard of), so
+            # the per-round 1-plane peer gather of round 5 (~1 ms of
+            # the ~5.5 ms round at W=16K, and one whole psum site in
+            # the sharded engine) disappears: the round's ONLY table
+            # gather is the fused [W·α·k] reply gather in merge().
+            # block_mode="exact" keeps the full-width gathered path.
+            if x_d0 is None:
+                x0 = gather_planar(x_rows, 1)[0]
+                x_d0 = x0 ^ tgt[:, 0:1]
+            b = clz32(x_d0)                      # clz32(0) == 32 by contract
             lo, ub = block_bounds(tgt[:, 0:1], b + 1)
         else:
             x_l = gather_planar(x_rows, N_LIMBS)     # full ids: exact cb
@@ -429,8 +467,26 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
             x_rows = jnp.stack(
                 [jnp.max(jnp.where(sel & (rank == j + 1), cand_node, -1),
                          axis=1) for j in range(alpha)], axis=1)
+            if block_bounds is not None:
+                # ROUND FUSION: the selected peers' top distance limb
+                # rides the same masked max-reductions (cand_l[0] is
+                # x0 ^ t0 — computed by the merge that first admitted
+                # the peer), so reply_gather needs NO table access to
+                # position the reply blocks and the round's only
+                # gather is the fused α·k-row reply fetch.  Bit-exact:
+                # a selected lane is unique per rank (cumsum), and
+                # unselected slots (x_rows = -1) get d0 = 0 → their
+                # replies are masked exactly as the gathered path
+                # masked them.
+                x_d0 = jnp.stack(
+                    [jnp.max(jnp.where(sel & (rank == j + 1), cand_l[0],
+                                       _U32(0)), axis=1)
+                     for j in range(alpha)], axis=1)
+            else:
+                x_d0 = None
 
-            new_rows = reply_gather(tgt, pt, qidx, x_rows, round_no + 1)
+            new_rows = reply_gather(tgt, pt, qidx, x_rows, round_no + 1,
+                                    x_d0)
             queried = jnp.where(sel, 1, queried)
             cand_node, cand_l, queried = merge(
                 tgt, cand_node, cand_l, queried, new_rows)
@@ -609,10 +665,11 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
 
     def gather_planar(rows, limbs=N_LIMBS):
         """rows [...] int32 → list of `limbs` limb arrays shaped like
-        rows (top limbs first — all the merge ranking needs)."""
-        cl = jnp.clip(rows, 0, N - 1).reshape(-1)
-        g = jnp.take(sorted_t[:limbs], cl, axis=1)     # [limbs, M]
-        return [g[l].reshape(rows.shape) for l in range(limbs)]
+        rows (top limbs first — all the merge ranking needs).  ONE
+        fused take per call — ops.sorted_table.fused_gather_planar is
+        the shared primitive (pinned against the xor_topk.gather_rows
+        oracle)."""
+        return fused_gather_planar(sorted_t, rows, limbs)
 
     return _lookup_engine(gather_planar, lower, n, targets,
                           jnp.arange(Q, dtype=jnp.int32), Q, seed_u,

@@ -330,12 +330,17 @@ class NodeTable:
         return bool(self.good_mask(now)[row])
 
     # ------------------------------------------------------------- mutation
-    def _touch(self) -> None:
+    def _touch(self, count_compaction: bool = True) -> None:
         """Structural change the churn view cannot absorb: drop both the
         base snapshot and the churn state (next view rebuilds).  A view
         carrying pending churn counts as a compaction — the rebuild it
-        forces folds that churn into the next base."""
-        if self._churn is not None and self._churn.pending:
+        forces folds that churn into the next base.  ``count_compaction
+        =False`` suppresses that increment for callers that already
+        counted the same event (the replay-overflow path of
+        :meth:`_maybe_swap`, which books its compaction before
+        replaying — ADVICE r5 finding 2's double count)."""
+        if count_compaction and self._churn is not None \
+                and self._churn.pending:
             self.compactions += 1
         self._version += 1
         self._snap = None
@@ -384,8 +389,12 @@ class NodeTable:
             if op == "i":
                 if not self._churn.note_insert(row, self._ids[row]):
                     # replay overflow (log larger than a fresh slab) —
-                    # correctness over latency: full rebuild
-                    self._touch()
+                    # correctness over latency: full rebuild.  The swap
+                    # was already counted above; without the flag the
+                    # partially-replayed view's pending entries made
+                    # _touch book the SAME event a second time
+                    # (ADVICE r5 finding 2).
+                    self._touch(count_compaction=False)
                     return True
             else:
                 self._churn.note_evict(row)
@@ -559,21 +568,51 @@ class NodeTable:
         seeder, testing/virtual_net.py) pass it to skip the per-call
         device dispatch of ``radix.bucket_of``.
 
-        Ids already live in the table and batch-internal duplicates are
+        Ids already LIVE in the table and batch-internal duplicates are
         dropped: live ids must stay unique across base and delta
         (note_insert's precondition — a duplicate would otherwise appear
-        twice in a top-k result through the churn merge)."""
+        twice in a top-k result through the churn merge).  Known ids
+        that have EXPIRED are not dropped: with ``replied=True`` (the
+        default) they revive exactly as ``insert(confirm=2)`` would —
+        address, reply clock, auth strikes and all (``_row_of`` also
+        holds expired rows, so the old skip left a re-seeded peer
+        permanently dead — ADVICE r5 finding 3); with
+        ``replied=False`` the re-sighting is hearsay and, as in
+        ``insert(confirm=0)``, refreshes only ``time_seen`` and the
+        address."""
         ids_u32 = np.asarray(ids_u32, dtype=np.uint32)
         raw = IK.ids_to_bytes(ids_u32)
+        per_row_addrs = isinstance(addrs, (list, tuple, np.ndarray))
         seen: set = set()
         keep: list = []
         for i in range(ids_u32.shape[0]):
             kb = raw[i].tobytes()
-            if kb in seen or kb in self._row_of:
+            if kb in seen:
+                continue
+            row = self._row_of.get(kb)
+            if row is not None:
+                # known id: refresh it the way insert() would — clocks
+                # and address — and revive it if expired
+                self._time_seen[row] = now
+                if addrs is not None:
+                    self._addrs[row] = addrs[i] if per_row_addrs else addrs
+                if replied:
+                    if self._expired[row]:
+                        # revival (↔ insert confirm=2): dead in every
+                        # view, re-enters as a delta insert
+                        self._expired[row] = False
+                        self._absorb_insert(row)
+                    elif self._time_reply[row] == 0 \
+                            and self._snap is not None \
+                            and self._snap.mask_key[0] == "good":
+                        # first reply: a cached 'good'-mask snapshot
+                        # goes stale (same rule as insert())
+                        self._touch()
+                    self._time_reply[row] = now
+                    self._auth_err[row] = 0
                 continue
             seen.add(kb)
             keep.append(i)
-        per_row_addrs = isinstance(addrs, (list, tuple, np.ndarray))
         if len(keep) != ids_u32.shape[0]:
             if per_row_addrs:
                 addrs = [addrs[i] for i in keep]

@@ -145,6 +145,36 @@ def lut_budget_steps(n_rows: int, bits: int) -> int:
     return max(6, math.ceil(math.log2(max(n_rows, 2))) - bits + 6)
 
 
+def fused_gather_planar(sorted_t, rows, limbs: int = N_LIMBS):
+    """ONE fused multi-row gather: ``limbs`` limb planes of arbitrary-
+    shaped row indices out of the TRANSPOSED [5, N] table.
+
+    THE table-access primitive of the iterative search round
+    (core/search.py): the round body packs every row it needs — all
+    α·k reply rows of every search in the wave — into a single flat
+    index vector, so the device issues exactly one gather per round
+    instead of one per candidate set (per-element gathers are
+    issue-bound at ~190K rows/ms on v5e; what matters is the *number
+    of gather ops on the serial chain*, not their element count, once
+    waves are small).  The transposed-table / planar-output form is the
+    lane-padding rule from the layout note in
+    :func:`~opendht_tpu.core.search.simulate_lookups`: a [M, 5] row
+    gather pads its minor dim 5 → 128 in TPU tiled layout; [5, M]
+    planes stay unpadded.
+
+    Exact by construction and pinned against the full-materialization
+    oracle :func:`~opendht_tpu.ops.xor_topk.gather_rows`
+    (tests/test_topk.py).  Out-of-range rows (e.g. the engine's -1
+    "absent" sentinel) are clipped, so their lanes carry garbage —
+    every caller masks them (the oracle returns the all-ones sentinel
+    there instead).
+    """
+    N = sorted_t.shape[1]
+    cl = jnp.clip(rows, 0, N - 1).reshape(-1)
+    g = jnp.take(sorted_t[:limbs], cl, axis=1)          # [limbs, M]
+    return [g[l].reshape(rows.shape) for l in range(limbs)]
+
+
 def _lex_lt(g, q_l, limbs: int):
     """Planar lexicographic row < query over ``limbs`` uint32 planes:
     ``g`` [limbs, M] gathered rows, ``q_l`` list of [M] query limbs.
@@ -353,6 +383,19 @@ EXPAND_STRIDE = 64
 EXPAND_LEN = 3 * EXPAND_STRIDE          # candidate window rows per entry
 _EROW = EXPAND_LEN + 2                  # + left/right certificate neighbors
 
+# Strides an expansion may be built with.  A closed set on purpose: the
+# consumer (:func:`expanded_topk`) infers (erow, stride) from
+# width // planes, and a MIS-DECLARED ``planes`` can alias
+# arithmetically — e.g. a 5-plane stride-64 row (970 lanes) read as
+# planes=2 parses to a "valid-looking" erow=485 / stride=161 and
+# produces silently wrong, certificate-passing windows (ADVICE r5
+# finding 1).  No supported stride is reachable by any cross-planes
+# misparse of another supported stride (asserted in tests/test_topk.py),
+# so validating the inferred stride against this set turns the silent
+# corruption into a loud ValueError.  Extend the set when sweeping new
+# geometries — membership is the only constraint.
+SUPPORTED_STRIDES = frozenset({8, 16, 24, 32, 42, 48, 64, 96, 128})
+
 
 @functools.partial(jax.jit, static_argnames=("stride", "limbs"))
 def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE,
@@ -389,7 +432,14 @@ def expand_table(sorted_ids, *, stride: int = EXPAND_STRIDE,
     at lookup time via n_valid masking).  Pure pad/reshape/concat — no
     gather.  Memory is 3× the table at any stride; halving the stride
     halves the per-query gather traffic and the in-window sort width.
+    ``stride`` must be registered in :data:`SUPPORTED_STRIDES` — the
+    closed set is what lets :func:`expanded_topk` reject a mis-declared
+    ``planes`` loudly instead of misparsing the row geometry.
     """
+    if stride not in SUPPORTED_STRIDES:
+        raise ValueError(f"stride {stride} not in SUPPORTED_STRIDES "
+                         f"{sorted(SUPPORTED_STRIDES)} — register new "
+                         "sweep geometries there")
     N = sorted_ids.shape[0]
     NB = -(-N // stride)
     nblk = NB + 4
@@ -423,6 +473,10 @@ def expand_table_chunked(sorted_ids, *, stride: int = EXPAND_STRIDE,
     Bit-identical to ``expand_table`` on the common rows
     (tests/test_topk.py).
     """
+    if stride not in SUPPORTED_STRIDES:
+        raise ValueError(f"stride {stride} not in SUPPORTED_STRIDES "
+                         f"{sorted(SUPPORTED_STRIDES)} — register new "
+                         "sweep geometries there")
     N = sorted_ids.shape[0]
     NB = -(-N // stride)
     NBc = -(-NB // chunks)
@@ -522,10 +576,7 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     if expanded.shape[1] % planes:
         # catches the easy mismatch now that 2- and 5-plane expansions
         # coexist for one table (e.g. a 2-plane stride-64 row is 388
-        # lanes — not divisible by the default planes=5).  The converse
-        # direction can alias arithmetically (490 lanes % 2 == 0), so
-        # the caller contract stands: `planes` MUST match the
-        # expand_table(limbs=) that built `expanded`.
+        # lanes — not divisible by the default planes=5).
         raise ValueError(
             f"expanded width {expanded.shape[1]} is not a multiple of "
             f"planes={planes} — pass the planes= the expansion was "
@@ -534,6 +585,19 @@ def expanded_topk(sorted_ids, expanded, n_valid, queries, *, k: int = 8,
     erow = expanded.shape[1] // planes      # lanes per limb plane = 3s+2
     wlen = erow - 2                         # candidate window rows = 3s
     stride = wlen // 3
+    if wlen != 3 * stride or stride not in SUPPORTED_STRIDES:
+        # the divisibility check above cannot catch every mis-declared
+        # `planes` (a 5-plane stride-64 row is 970 lanes — divisible by
+        # 2 — and would silently misparse to stride 161); no supported
+        # stride is reachable by a cross-planes misparse of another, so
+        # this turns silently-wrong certified windows into a loud error
+        # (ADVICE r5 finding 1).
+        raise ValueError(
+            f"expanded width {expanded.shape[1]} with planes={planes} "
+            f"infers stride {wlen / 3:g} not in SUPPORTED_STRIDES "
+            f"{sorted(SUPPORTED_STRIDES)} — `planes` does not match the "
+            "expand_table(limbs=) the expansion was built with, or the "
+            "stride is unregistered")
     n_valid = jnp.asarray(n_valid, jnp.int32)
 
     pos = _lower_bound(sorted_ids, queries, n_valid, lut=lut,

@@ -43,6 +43,27 @@ from .ids import N_LIMBS, xor_ids
 _U32 = jnp.uint32
 
 
+def gather_rows(table, rows):
+    """Reference row-materialization oracle for the fused planar gather
+    (``ops.sorted_table.fused_gather_planar``): ``rows`` [...] int32 →
+    uint32 [..., 5] table rows, with out-of-range rows (including the
+    engine's -1 "absent" sentinel) returned as all-ones — the same
+    canonical sentinel :func:`mask_invalid` uses.
+
+    This is the oracle the round-fused reply gather of the iterative
+    search engine (core/search.py) is pinned against: the fused gather
+    returns *limb planes* and leaves out-of-range lanes as garbage for
+    the caller to mask, so the test contract is "masked fused planes ==
+    gather_rows limbs" (tests/test_topk.py).  Scan-free and shape-naive
+    on purpose — an oracle, not a kernel.
+    """
+    N = table.shape[0]
+    ok = (rows >= 0) & (rows < N)
+    g = jnp.take(table, jnp.clip(rows, 0, N - 1).reshape(-1),
+                 axis=0).reshape(tuple(rows.shape) + (N_LIMBS,))
+    return jnp.where(ok[..., None], g, jnp.uint32(0xFFFFFFFF))
+
+
 def select_topk(dist, idx, inv, k):
     """Top-k rows of [Q, C] candidates via one lexicographic sort.
 

@@ -37,6 +37,25 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax moved shard_map out of experimental AND (separately, later)
+# renamed check_rep → check_vma; the two changes don't coincide, so the
+# kwarg is chosen by the resolved function's own signature rather than
+# by where it lives (a mid-window release has top-level jax.shard_map
+# that still takes check_rep).  Resolved once so every builder below is
+# version-agnostic.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:                                     # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+import inspect as _inspect
+try:
+    _sm_params = _inspect.signature(_shard_map).parameters
+except (TypeError, ValueError):           # C-level/odd callables
+    _sm_params = {}
+_SM_KW = ({"check_vma": False} if "check_vma" in _sm_params
+          else {"check_rep": False} if "check_rep" in _sm_params
+          else {})
+
 from ..ops.ids import N_LIMBS
 from ..ops.xor_topk import xor_topk, select_topk, mask_invalid
 from ..ops.sorted_table import (sort_table, window_topk, build_prefix_lut,
@@ -105,11 +124,11 @@ def _build_sharded_xor_topk(mesh: Mesh, k: int, tile: int, shard_n: int):
         gidx = jnp.where(idx >= 0, idx + ti * shard_n, -1)
         return _gather_and_merge(dist, gidx, n_t, k)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(P("q", None), P("t", None), P("t")),
         out_specs=(P("q", None, None), P("q", None)),
-        check_vma=False,
+        **_SM_KW,
     )
     return jax.jit(fn)
 
@@ -141,11 +160,11 @@ def _build_sharded_sort(mesh: Mesh):
         sorted_ids, perm, n_valid = sort_table(tbl, val)
         return sorted_ids, perm, jnp.asarray(n_valid, jnp.int32)[None]
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(P("t", None), P("t")),
         out_specs=(P("t", None), P("t"), P("t")),
-        check_vma=False,
+        **_SM_KW,
     )
     return jax.jit(fn)
 
@@ -171,11 +190,11 @@ def _build_sharded_expand(mesh: Mesh, bits: int):
         lut = build_prefix_lut(sorted_ids, n_valid_shard[0], bits=bits)
         return expanded, lut[None]
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(P("t", None), P("t")),
         out_specs=(P("t", None), P("t", None)),
-        check_vma=False,
+        **_SM_KW,
     )
     return jax.jit(fn)
 
@@ -232,12 +251,12 @@ def _build_sharded_window_lookup(mesh: Mesh, k: int, window: int,
         gidx = jnp.where(rows >= 0, rows + ti * shard_n, -1)
         return _gather_and_merge(dist2, gidx, n_t, k)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(P("q", None), P("t", None), P("t"), P("t"),
                   P("t", None), P("t", None)),
         out_specs=(P("q", None, None), P("q", None)),
-        check_vma=False,
+        **_SM_KW,
     )
     return jax.jit(fn)
 
@@ -333,8 +352,14 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
                      build_prefix_lut(sorted_shard, n_local, bits=bb))
 
         def block_bounds(t0, prefix_len):
+            # ONE stacked psum for both edges (round 6): summing the
+            # [2, ...] (lo, ub) pair in a single collective halves the
+            # in-loop all-reduce sites the block edges cost — addition
+            # is elementwise, so the stacked sum is bit-identical to
+            # two separate psums.
             lo, ub = _lut_block_bounds(block_lut, t0, prefix_len)
-            return lax.psum(lo, "t"), lax.psum(ub, "t")
+            s = lax.psum(jnp.stack([lo, ub]), "t")
+            return s[0], s[1]
 
         def gather_planar(rows, limbs=N_LIMBS):
             # distributed row fetch: the owning shard contributes the
@@ -342,6 +367,10 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
             # Rows are pre-clipped to [0, n) by the engine; -1 (absent)
             # rows land out of range on every shard and come back 0,
             # masked by the engine exactly like the unsharded garbage.
+            # With the round-6 fused engine this runs ONCE per round
+            # (the α·k reply fetch): the per-round 1-limb peer fetch's
+            # psum site is gone — the engine reads the carried
+            # candidate distance instead (core/search.py).
             flat = (rows - base).reshape(-1)
             ok = (flat >= 0) & (flat < shard_n)
             g = jnp.take(sorted_t[:limbs], jnp.clip(flat, 0, shard_n - 1),
@@ -358,12 +387,12 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
                               max_hops=max_hops, state_limbs=state_limbs,
                               block_bounds=block_bounds)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(P("t", None), P(), P("q", None), P()),
         out_specs={"nodes": P("q", None), "dist": P("q", None, None),
                    "hops": P("q"), "converged": P("q")},
-        check_vma=False,
+        **_SM_KW,
     )
     return jax.jit(fn)
 

@@ -15,8 +15,41 @@ opendht_tpu.testing.benchmark`` (↔ benchmark.py).
 """
 
 from .virtual_net import VirtualNet
-from .network import DhtNetwork
-from .scenarios import PerformanceTest, PersistenceTest, LatencyStats
+
+# The real-UDP backends ride DhtRunner and therefore the
+# ``cryptography`` wheel; resolve them lazily (PEP 562, same rule as
+# the package root) so plain `import opendht_tpu.testing` — and with it
+# the crypto-free virtual-clock tier the hop-parity ladder uses —
+# works everywhere.  (A STAR import still materializes every __all__
+# name and so still needs the wheel, as the fully-eager module did.)
+_LAZY_EXPORTS = {
+    "DhtNetwork": ".network",
+    "PerformanceTest": ".scenarios",
+    "PersistenceTest": ".scenarios",
+    "LatencyStats": ".scenarios",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    try:
+        value = getattr(importlib.import_module(mod, __name__), name)
+    except ModuleNotFoundError as e:
+        # soft-introspection rule of the package root's __getattr__
+        raise AttributeError(
+            f"opendht_tpu.testing.{name} requires the optional "
+            f"'{e.name}' package (VirtualNet and the virtual-clock "
+            f"tier work without it)") from e
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))
+
 
 __all__ = ["VirtualNet", "DhtNetwork", "PerformanceTest",
            "PersistenceTest", "LatencyStats"]

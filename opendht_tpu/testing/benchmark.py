@@ -93,7 +93,11 @@ def main(argv=None) -> int:
         p.error("--real is only implemented for -t gets")
 
     import jax
-    from ..tools.common import force_cpu_jax
+    # from the tools PACKAGE, not tools.common: common eagerly imports
+    # the crypto-backed runner stack, and the VIRTUAL harness must stay
+    # runnable without the optional ``cryptography`` wheel (the --real
+    # mode imports it on use)
+    from ..tools import force_cpu_jax
     force_cpu_jax()
     if jax.default_backend() != "cpu":
         # the axon TPU tunnel admits one client; never grab it by accident

@@ -1,3 +1,17 @@
 """CLI tools (↔ reference tools/): dhtnode interactive node/daemon,
 dhtchat minimal IM, dhtscanner keyspace census, plus shared argv/identity
 helpers (↔ tools/tools_common.h)."""
+
+
+def force_cpu_jax() -> None:
+    """Pin JAX to the CPU backend (host tools must never grab the
+    single-client TPU tunnel; accelerator init would also stall the
+    protocol thread).  Lives HERE — not in tools.common, which eagerly
+    imports the crypto-backed runner stack — so crypto-free callers
+    (the virtual cluster harness, testing/benchmark.py) share the one
+    pinning recipe."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

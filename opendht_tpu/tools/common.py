@@ -17,15 +17,10 @@ from ..runtime.config import Config
 from ..runtime.runner import DhtRunner, RunnerConfig
 
 
-def force_cpu_jax() -> None:
-    """Pin JAX to the CPU backend (host tools must never grab the
-    single-client TPU tunnel; accelerator init would also stall the
-    protocol thread — see setup_node's --tpu flag)."""
-    try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+# canonical definition lives in the (crypto-free) package __init__ so
+# the virtual harness can use it without this module's runner imports;
+# re-exported here for the CLI tools and back-compat
+from . import force_cpu_jax  # noqa: F401,E402
 
 
 def make_arg_parser(description: str) -> argparse.ArgumentParser:

@@ -215,6 +215,42 @@ def test_survivor_compaction_bitwise_identical():
                                       np.asarray(ref[key]))
 
 
+def test_engine_reply_stream_goldens():
+    """The deterministic reply streams are pinned by committed goldens
+    (tests/goldens/search_engine.json): the round-6 ROUND-FUSED engine
+    (one fused [W·α·k] reply gather per round; block edges positioned
+    from the carried candidate distance limb instead of a per-round
+    peer gather) must reproduce the round-5 engine's outputs bit for
+    bit — as must any future refactor, since wave streaming, survivor
+    compaction, and tp-sharding all lean on stream determinism keyed
+    by (seed, global query id, round)."""
+    import hashlib
+    import json
+    import os
+
+    with open(os.path.join(os.path.dirname(__file__), "goldens",
+                           "search_engine.json")) as f:
+        gold = json.load(f)
+    rng = np.random.default_rng(1234)
+    ids = rng.integers(0, 2**32, size=(4096, 5), dtype=np.uint32)
+    targets = jnp.asarray(rng.integers(0, 2**32, size=(96, 5),
+                                       dtype=np.uint32))
+    sorted_ids, _, n = sort_table(jnp.asarray(ids))
+    for tag, kw in (("lut_l5", {}), ("lut_l2", {"state_limbs": 2}),
+                    ("exact_l5", {"block_mode": "exact"})):
+        out = simulate_lookups(sorted_ids, n, targets, seed=99, **kw)
+        h = hashlib.sha256()
+        for key in ("nodes", "hops", "converged", "dist"):
+            h.update(np.ascontiguousarray(np.asarray(out[key])).tobytes())
+        assert h.hexdigest() == gold[tag]["sha256"], (
+            tag, np.bincount(np.asarray(out["hops"]), minlength=12)[:12],
+            gold[tag]["hops_hist"])
+        np.testing.assert_array_equal(np.asarray(out["nodes"])[0],
+                                      gold[tag]["nodes_row0"], err_msg=tag)
+        assert int(np.asarray(out["converged"]).sum()) \
+            == gold[tag]["converged"], tag
+
+
 def test_lut_block_bounds_exact_up_to_lut_width():
     """_lut_block_bounds must equal the exact prefix-block edges for any
     prefix length <= the LUT width — on clustered tables too (the

@@ -198,6 +198,47 @@ def test_find_closest_good_mask():
     assert len(ids) == 8
 
 
+def test_bulk_load_revives_expired():
+    """ADVICE r5 finding 3: ``_row_of`` also holds expired rows, and
+    bulk_load's dedup used to skip them — a re-seeded expired peer
+    stayed dead forever while ``insert(confirm=2)`` would revive it.
+    Now only LIVE known ids are dropped: with replied=True an expired
+    id revives (insert(confirm=2) semantics, no duplicate row); with
+    replied=False the re-sighting is hearsay and only refreshes
+    time_seen."""
+    rng = np.random.default_rng(33)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=64)
+    raw = rng.integers(0, 256, (20, 20), dtype=np.uint8)
+    ids = K.ids_from_bytes(raw)
+    t.bulk_load(ids, now=10.0)
+    assert len(t) == 20
+    dead = InfoHash(raw[3].tobytes())
+    t.on_expired(dead)
+    row = t.row_of(dead)
+    assert t._expired[row]
+    # hearsay re-sight: time_seen refreshes, the row stays dead
+    t.bulk_load(ids[3:4], now=20.0, replied=False)
+    assert t._expired[row] and t._time_seen[row] == 20.0
+    # replied re-seed (dup of a live id + the expired one + a fresh id):
+    # revives in place, dedupes the live, adds only the fresh — and the
+    # caller's address lands on the revived row like insert() would
+    # store it (a revived peer with a stale/None addr is unservable in
+    # closest-node replies)
+    fresh = rng.integers(0, 256, (1, 20), dtype=np.uint8)
+    batch = np.concatenate([np.asarray(ids[2:5]), K.ids_from_bytes(fresh)])
+    t.bulk_load(batch, now=30.0,
+                addrs=[("10.0.0.2", 4222), ("10.0.0.3", 4223),
+                       ("10.0.0.4", 4224), ("10.0.0.9", 4229)])
+    assert len(t) == 21
+    assert t.row_of(dead) == row and not t._expired[row]
+    assert t._time_reply[row] == 30.0
+    assert t._addrs[row] == ("10.0.0.3", 4223)
+    # the revived peer serves again in closest-node reads
+    rows, _ = t.find_closest([dead], k=1, now=31.0)
+    assert int(rows[0][0]) == row
+
+
 def test_bulk_load_and_maintenance():
     rng = np.random.default_rng(10)
     me = _rand_hash(rng)

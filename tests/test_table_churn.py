@@ -291,6 +291,42 @@ def test_nodetable_delta_overflow_grows_and_compacts_nonblocking():
     np.testing.assert_array_equal(dist3, dist_host)
 
 
+def test_replay_overflow_counts_one_compaction():
+    """ADVICE r5 finding 2: when _maybe_swap's mutation-log replay
+    overflows the fresh delta slab, the forced full rebuild used to
+    book the SAME compaction twice (once in _maybe_swap, once again in
+    _touch via the partially-replayed view's pending entries).  The
+    whole episode — swap + overflow + rebuild — must count exactly one
+    compaction, and lookups must stay exact through it."""
+    rng = np.random.default_rng(53)
+    self_id = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+    t = NodeTable(self_id, capacity=512, k=64, delta_cap=4)
+    for h in _rand_hashes(rng, 40):
+        t.insert(h, None, now=1.0, confirm=2)
+    t.snapshot(now=2.0)
+    for h in _rand_hashes(rng, 5):          # 5th overflows delta_cap=4 →
+        t.insert(h, None, now=3.0, confirm=2)   # background compaction
+    assert t._pending_base is not None
+    c0 = t.compactions
+    # more post-dispatch inserts than the FRESH view's slab (4) holds →
+    # the replay at swap must overflow
+    late = _rand_hashes(rng, 6)
+    for h in late:
+        t.insert(h, None, now=4.0, confirm=2)
+    assert sum(op == "i" for op, _ in t._pending_base["mutlog"]) > 4
+    v = t.view(5.0)                         # swap + overflowing replay
+    assert t._pending_base is None
+    assert t.compactions == c0 + 1, \
+        "replay overflow double-counted the compaction"
+    # exactness through the episode: every late insert resolvable
+    q = K.ids_from_hashes(late[:4])
+    rows, dist = v.lookup(q, k=1)
+    for qi in range(4):
+        assert rows[qi, 0] >= 0
+        assert np.array_equal(t._ids[int(rows[qi, 0])],
+                              np.asarray(q)[qi])
+
+
 def test_bulk_load_during_pending_compaction_replays_at_swap(monkeypatch):
     """Rows bulk-loaded while a background compaction is in flight must
     reach the pending build's mutation log — or they vanish from the

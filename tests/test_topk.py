@@ -227,6 +227,82 @@ def _expanded_setup(table_raw, valid=None, bits=16):
     return sorted_ids, perm, n_valid, lut, T2
 
 
+def test_fused_gather_planar_matches_row_oracle():
+    """The fused multi-row gather (the ONE table access of the round-
+    fused search round, core/search.py) must agree with the full
+    row-materialization oracle ``xor_topk.gather_rows`` on every
+    in-range lane, for any rows shape, any limb count, and with the
+    engine's -1 "absent" sentinel present (whose lanes the gather
+    leaves as clipped garbage for the caller to mask — the oracle's
+    all-ones sentinel marks exactly the lanes the contract excludes)."""
+    from opendht_tpu.ops.sorted_table import fused_gather_planar
+    from opendht_tpu.ops.xor_topk import gather_rows
+
+    rng = np.random.default_rng(61)
+    table = jnp.asarray(
+        rng.integers(0, 2**32, size=(503, 5), dtype=np.uint32))
+    table_t = table.T
+    for shape in ((64,), (16, 24), (8, 3, 8)):
+        rows = rng.integers(-1, 503, size=shape).astype(np.int32)
+        rows.flat[0] = -1                       # always one absent lane
+        rows.flat[-1] = 502
+        want = np.asarray(gather_rows(table, jnp.asarray(rows)))
+        ok = rows >= 0
+        for limbs in (1, 2, 5):
+            got = fused_gather_planar(table_t, jnp.asarray(rows), limbs)
+            assert len(got) == limbs
+            for l in range(limbs):
+                np.testing.assert_array_equal(
+                    np.asarray(got[l])[ok], want[..., l][ok],
+                    err_msg=f"shape={shape} limb={l}")
+
+
+def test_expanded_topk_rejects_misdeclared_planes():
+    """ADVICE r5 finding 1: a 5-plane expansion read with planes=2
+    aliases arithmetically (970 lanes % 2 == 0 → stride \"161\") and
+    used to produce silently wrong certified windows.  The inferred
+    stride is now validated against SUPPORTED_STRIDES, so every
+    cross-planes misparse of every supported stride fails loudly —
+    checked exhaustively below — and unregistered build strides are
+    rejected at expansion time."""
+    from opendht_tpu.ops.sorted_table import (SUPPORTED_STRIDES,
+                                              expand_table,
+                                              expand_table_chunked,
+                                              expanded_topk)
+
+    rng = np.random.default_rng(77)
+    ids = jnp.asarray(rng.integers(0, 2**32, size=(512, 5),
+                                   dtype=np.uint32))
+    sorted_ids, _, n_valid = sort_table(ids)
+    q = ids[:8]
+
+    # the aliasing case from the advisory: 5-plane stride-64 as planes=2
+    e5 = expand_table(sorted_ids, stride=64)
+    with pytest.raises(ValueError, match="SUPPORTED_STRIDES"):
+        expanded_topk(sorted_ids, e5, n_valid, q, select="fast2", planes=2)
+    # the easy direction stays caught too (width not divisible)
+    e2 = expand_table(sorted_ids, stride=64, limbs=2)
+    with pytest.raises(ValueError, match="not a multiple"):
+        expanded_topk(sorted_ids, e2, n_valid, q, planes=5)
+    # unregistered stride refused at build time, both builders
+    with pytest.raises(ValueError, match="SUPPORTED_STRIDES"):
+        expand_table(sorted_ids, stride=20)
+    with pytest.raises(ValueError, match="SUPPORTED_STRIDES"):
+        expand_table_chunked(sorted_ids, stride=20)
+
+    # the closed set really is misparse-free: no cross-planes read of
+    # any supported stride infers another supported stride
+    for s in SUPPORTED_STRIDES:
+        for p1, p2 in ((5, 2), (2, 5)):
+            width = p1 * (3 * s + 2)
+            if width % p2:
+                continue                      # caught by the modulo check
+            erow2 = width // p2
+            wlen2 = erow2 - 2
+            assert not (wlen2 % 3 == 0 and wlen2 // 3 in SUPPORTED_STRIDES), \
+                (s, p1, p2)
+
+
 def test_expand_table_rows_cover_windows():
     """Row j of the expanded table is limb-planar sorted rows
     [64j-1, 64j+193), with zero sentinels at both ends."""
