@@ -1,0 +1,112 @@
+"""Shared fixture builders for the churn benchmark drivers.
+
+exp_churn_r5.py, exp_churn2_r5.py and exp_churn_r7.py all need the same
+scaffolding — a sorted+expanded+LUT'd random base table, a query wave,
+a delta slab with pre-built sorted/expanded/LUT structures, and the
+per-round mutation arrays (tombstone word writes + delta appends) in
+the idempotent form the chain-slope methodology requires.  Before
+round 7 each driver rebuilt these inline (ISSUE 2 satellite 1); this
+module is the single definition.
+
+Device-array building imports jax lazily inside each function so the
+drivers keep controlling platform selection (jax.config.update before
+first backend use — see ci/run_ci.sh's heredoc note).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sizes(on_accel: bool, *, dcap: int = 0):
+    """The canonical churn-bench shape: (N table rows, Q wave width,
+    DCAP delta-slab capacity).  65536 is the measured accelerator
+    optimum for DCAP (round-5 sweep; see baseline_configs.config6)."""
+    N = 10_000_000 if on_accel else 200_000
+    Q = 131_072 if on_accel else 8_192
+    return N, Q, dcap or (65_536 if on_accel else 8_192)
+
+
+def build_base(N: int, Q: int, *, seed: int = 7, limbs: int = 2):
+    """Random sorted base table + query wave + serving structures.
+
+    Returns a dict with device arrays ``sorted_ids`` [N,5],
+    ``expanded`` (``limbs``-plane stride-64 expansion), ``lut``,
+    ``n_valid``, ``queries`` [Q,5], plus ``key3`` (a spare PRNG key for
+    driver-specific extras, e.g. the exactness-sample batch).
+    """
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.ops.sorted_table import (
+        sort_table, build_prefix_lut, default_lut_bits, expand_table)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
+    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
+    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
+    del table
+    expanded = jax.block_until_ready(expand_table(sorted_ids, limbs=limbs))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    return {"sorted_ids": sorted_ids, "expanded": expanded, "lut": lut,
+            "n_valid": n_valid, "queries": queries, "key3": k3}
+
+
+def build_mutations(N: int, DCAP: int, E: int, *, seed: int = 70,
+                    fill_frac: float = 0.5):
+    """Host-side churn state for one idempotent timed round: a delta
+    slab ``fill_frac`` full, E new ids staged for the round's append,
+    E tombstone word writes (values precomputed so chain reps are
+    idempotent — required by the slope methodology), and an all-zero
+    tombstone base.
+
+    Returns a dict of device arrays ``tomb_base`` [ceil(N/32)],
+    ``widx``/``wval`` [E] (word indices + post-write values),
+    ``dslab`` [DCAP,5], ``new_ids`` [E,5], ``nd0`` (int, rows live
+    before the append), ``nd_after`` (int32 scalar, rows live after).
+    """
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    nwords = (N + 31) // 32
+    dslab_np = rng.integers(0, 2**32, size=(DCAP, 5), dtype=np.uint32)
+    nd0 = int(DCAP * fill_frac)
+    new_ids = rng.integers(0, 2**32, size=(E, 5), dtype=np.uint32)
+    widx = rng.integers(0, nwords, size=E, dtype=np.int64)
+    return {"tomb_base": jnp.zeros((nwords,), jnp.uint32),
+            "widx": jnp.asarray(widx),
+            "wval": jnp.zeros((E,), jnp.uint32),
+            "dslab": jnp.asarray(dslab_np),
+            "new_ids": jnp.asarray(new_ids),
+            "nd0": nd0, "nd_after": jnp.int32(nd0 + E)}
+
+
+def build_delta_structs(dslab, n_live, *, strides=(16, 64), limbs: int = 2):
+    """Pre-built serving structures for a delta slab state (the
+    no-rebuild variants and the static comparators): sorted slab, one
+    expansion per requested stride, and the delta LUT.
+
+    Returns (d_sorted, [expansion per stride], d_lut, d_n_valid).
+    """
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.ops.sorted_table import (
+        sort_table, build_prefix_lut, default_lut_bits, expand_table)
+
+    DCAP = dslab.shape[0]
+    ds, _dp, dnv = jax.block_until_ready(
+        sort_table(dslab, jnp.arange(DCAP) < n_live))
+    exps = [jax.block_until_ready(expand_table(ds, stride=s, limbs=limbs))
+            for s in strides]
+    dlut = jax.block_until_ready(
+        build_prefix_lut(ds, dnv, bits=default_lut_bits(DCAP)))
+    return ds, exps, dlut, dnv
+
+
+def random_delta_slab(DCAP: int, *, seed: int):
+    """A standalone random [DCAP, 5] delta slab as a device array (the
+    exp_churn_r5 per-capacity sweep)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.random.bits(jax.random.PRNGKey(seed), (DCAP, 5),
+                           dtype=jnp.uint32)

@@ -3,6 +3,10 @@
 add up to the measured round, so, as with the search engine
 (exp_round_r5.py), each variant disables one piece of the REAL round
 body and (full − variant) attributes cost with fusion effects included.
+
+Fixtures (base table, delta slab, idempotent mutation arrays) come
+from benchmarks/churn_fixtures.py — the shared scaffolding of every
+churn driver since round 7.
 """
 
 from __future__ import annotations
@@ -11,9 +15,9 @@ import json
 import os
 import sys
 
-import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)          # churn_fixtures, when loaded by path
 
 
 def main(argv=None) -> int:
@@ -24,43 +28,25 @@ def main(argv=None) -> int:
     from opendht_tpu.ops.sorted_table import (
         sort_table, build_prefix_lut, default_lut_bits, expand_table,
         churn_lookup_topk, expanded_topk)
+    import churn_fixtures as FX
 
     on_accel = jax.devices()[0].platform != "cpu"
-    N = 10_000_000 if on_accel else 200_000
-    Q = 131_072 if on_accel else 8_192
-    DCAP = 65_536 if on_accel else 8_192
+    N, Q, DCAP = FX.sizes(on_accel, dcap=65_536 if on_accel else 8_192)
     E, K = 256, 8
-    lut_bits = default_lut_bits(N)
     d_bits = default_lut_bits(DCAP)
 
-    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
-    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
-    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
-    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
-    del table
-    expanded = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
-    lut = jax.block_until_ready(
-        build_prefix_lut(sorted_ids, n_valid, bits=lut_bits))
+    base = FX.build_base(N, Q, limbs=2)
+    sorted_ids, expanded = base["sorted_ids"], base["expanded"]
+    lut, n_valid, queries = base["lut"], base["n_valid"], base["queries"]
 
-    rng = np.random.default_rng(70)
-    nwords = (N + 31) // 32
-    tomb_np = rng.integers(0, 2**32, size=nwords, dtype=np.uint32) & 0
-    dslab_np = rng.integers(0, 2**32, size=(DCAP, 5), dtype=np.uint32)
-    nd0 = DCAP // 2
-    tomb_base = jnp.asarray(tomb_np)
-    dslab = jnp.asarray(dslab_np)
-    new_ids = jnp.asarray(
-        rng.integers(0, 2**32, size=(E, 5), dtype=np.uint32))
-    widx = jnp.asarray(rng.integers(0, nwords, size=E, dtype=np.int64))
-    wval = jnp.zeros((E,), jnp.uint32)
-    nd_after = jnp.int32(nd0 + E)
+    mut = FX.build_mutations(N, DCAP, E)
+    tomb_base, widx, wval = mut["tomb_base"], mut["widx"], mut["wval"]
+    dslab, new_ids = mut["dslab"], mut["new_ids"]
+    nd0, nd_after = mut["nd0"], mut["nd_after"]
 
     # pre-built delta structures for the no-rebuild variant
-    ds0, _dp0, dnv0 = jax.block_until_ready(
-        sort_table(dslab, jnp.arange(DCAP) < nd_after))
-    de0 = jax.block_until_ready(expand_table(ds0, stride=16, limbs=2))
-    dew0 = jax.block_until_ready(expand_table(ds0, stride=64, limbs=2))
-    dlut0 = jax.block_until_ready(build_prefix_lut(ds0, dnv0, bits=d_bits))
+    ds0, (de0, dew0), dlut0, _dnv0 = FX.build_delta_structs(
+        dslab.at[nd0:nd0 + E].set(new_ids), nd0 + E, strides=(16, 64))
 
     def make_round(variant):
         def round_body(q, sorted_ids, expanded, lut, n_valid, tomb_base,
@@ -101,16 +87,16 @@ def main(argv=None) -> int:
                     + jnp.sum(enc[:, 0].astype(jnp.float32)) * 1e-9)
         return round_body
 
-    base = None
+    base_dt = None
     for v in ("full", "no_rebuild", "base_only", "delta_only"):
         dt = chain_slope(make_round(v), queries, sorted_ids, expanded, lut,
                          n_valid, tomb_base, widx, wval, dslab, new_ids,
                          nd_after, ds0, de0, dew0, dlut0, r1=2, r2=8)
         rec = {"variant": v, "ms": round(dt * 1e3, 2)}
         if v == "full":
-            base = dt
-        elif base:
-            rec["delta_vs_full_ms"] = round((base - dt) * 1e3, 2)
+            base_dt = dt
+        elif base_dt:
+            rec["delta_vs_full_ms"] = round((base_dt - dt) * 1e3, 2)
         print(json.dumps(rec), flush=True)
 
     # static comparator, same session

@@ -6,6 +6,9 @@ round component on the chip so the rebuild targets the measured cost:
 per-round delta re-sort/expand/LUT at several slab tiers, the delta
 window lookup at stride 32 vs 16, the 2k merge sort row- vs
 column-oriented, and the tombstone overhead on the base side.
+
+Base-table scaffolding comes from benchmarks/churn_fixtures.py (shared
+with exp_churn2_r5.py / exp_churn_r7.py since round 7).
 """
 
 from __future__ import annotations
@@ -16,7 +19,9 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)          # churn_fixtures, when loaded by path
 
 
 def main(argv=None) -> int:
@@ -27,19 +32,14 @@ def main(argv=None) -> int:
     from opendht_tpu.ops.sorted_table import (
         sort_table, build_prefix_lut, default_lut_bits, expand_table,
         expanded_topk)
+    import churn_fixtures as FX
 
     on_accel = jax.devices()[0].platform != "cpu"
-    N = 10_000_000 if on_accel else 200_000
-    Q = 131_072 if on_accel else 8_192
+    N, Q, _dcap = FX.sizes(on_accel)
     K = 8
-    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
-    table = jax.random.bits(k1, (N, 5), dtype=jnp.uint32)
-    queries = jax.random.bits(k2, (Q, 5), dtype=jnp.uint32)
-    sorted_ids, _p, n_valid = jax.block_until_ready(sort_table(table))
-    del table
-    lut = jax.block_until_ready(build_prefix_lut(
-        sorted_ids, n_valid, bits=default_lut_bits(N)))
-    exp2 = jax.block_until_ready(expand_table(sorted_ids, limbs=2))
+    base = FX.build_base(N, Q, limbs=2)
+    sorted_ids, exp2 = base["sorted_ids"], base["expanded"]
+    lut, n_valid, queries = base["lut"], base["n_valid"], base["queries"]
     nwords = (N + 31) // 32
     tomb = jnp.zeros((nwords,), jnp.uint32)
 
@@ -48,7 +48,7 @@ def main(argv=None) -> int:
               flush=True)
 
     # base lookup with and without tombstones
-    def base(q, sorted_ids, exp2, n_valid, lut):
+    def base_body(q, sorted_ids, exp2, n_valid, lut):
         d, i, c = expanded_topk(sorted_ids, exp2, n_valid, q, k=K,
                                 select="fast2", lut=lut, lut_steps=0,
                                 planes=2)
@@ -63,7 +63,7 @@ def main(argv=None) -> int:
                 + jnp.sum(i[:, 0].astype(jnp.float32)) * 1e-9)
 
     report("base lookup (static)", chain_slope(
-        base, queries, sorted_ids, exp2, n_valid, lut, r1=4, r2=16))
+        base_body, queries, sorted_ids, exp2, n_valid, lut, r1=4, r2=16))
     report("base lookup + tomb", chain_slope(
         base_tomb, queries, sorted_ids, exp2, n_valid, lut, tomb,
         r1=4, r2=16))
@@ -71,8 +71,7 @@ def main(argv=None) -> int:
     for DCAP in (262_144, 65_536, 16_384):
         if not on_accel and DCAP > 65_536:
             continue
-        kd = jax.random.PRNGKey(100 + DCAP)
-        dslab = jax.random.bits(kd, (DCAP, 5), dtype=jnp.uint32)
+        dslab = FX.random_delta_slab(DCAP, seed=100 + DCAP)
         nd = jnp.int32(DCAP // 2)
         d_bits = default_lut_bits(DCAP)
 

@@ -67,6 +67,24 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke"])
 assert rc == 0, "wave-latency smoke failed"
 PY
+# churn-merge smoke (round 7): the lane-packed merge must stay
+# BIT-IDENTICAL to the unpacked merge through the SHIPPING
+# churn_lookup_topk (fast2 + fast3, ragged wave) and the packed round
+# must not regress past a generous 1.5x band vs the unpacked round —
+# a merge-stage latency regression fails here without the full bench.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_churn_r7", pathlib.Path("benchmarks/exp_churn_r7.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-Q", "1025", "--dcap", "1024",
+             "-E", "64"])
+assert rc == 0, "churn-merge smoke failed"
+PY
 # table-sharded iterative mode on a REAL 8-device virtual mesh.  The
 # heredoc (rather than env vars + the module CLI) is deliberate: on
 # hosts that register an accelerator backend via sitecustomize, the

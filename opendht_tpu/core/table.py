@@ -126,8 +126,11 @@ class ChurnView:
     ops/sorted_table.churn_lookup_topk — tombstone-masked window top-k
     over the base, window top-k over the delta (kept as its own mini
     sorted+expanded table, re-sorted lazily per mutation batch), one
-    2k-wide merge — in a single device call, bit-identical to a full
-    re-sort of the mutated id set.  Device state is refreshed lazily:
+    lane-packed merge (on TPU, 128//k queries share each 128-lane
+    physical row, ops/sorted_table.packed_churn_merge — the round-7
+    amortizer for the [Q, k] padding tax) — in a single device call,
+    bit-identical to a full re-sort of the mutated id set.  Device
+    state is refreshed lazily:
     tombstone words re-upload whole (1.25 MB per 10M rows — noise), the
     delta re-sorts on device (one small sort+expand per dirty batch).
 

@@ -968,15 +968,119 @@ def _fallback_tile(n_rows: int, q: int) -> int:
     return max(1, min(n_rows, t))
 
 
+def _resolve_merge_pack(pack, k: int) -> int:
+    """``merge_pack="auto"`` → as many queries per 128-lane physical row
+    as k allows (P·k ≤ 128; 16 at the protocol k=8) on TPU, where the
+    minor-dim pad tax the packing amortizes exists — and 1 elsewhere:
+    on cpu the packed merge STAGE measured ~10× the unpacked stage
+    (16.6 ms vs ~1.6 ms over the no-merge variant; −15 ms ≈ −3.5% at
+    the whole-round level — captures/churn_packed.json), the same
+    backend split window_topk's ``select="auto"`` makes.  Any int ≥ 1
+    is valid — P=1 is the unpacked merge."""
+    if pack == "auto":
+        return (max(1, 128 // k)
+                if jax.default_backend() == "tpu" else 1)
+    p = int(pack)
+    if p < 1:
+        raise ValueError(f"merge_pack must be >= 1 (got {pack!r})")
+    return p
+
+
+def packed_churn_merge(m_dist, m_idx, d_dist, d_idx, n_base, *, k: int,
+                       nl: int, pack: int = 1):
+    """Lane-packed base∪delta candidate merge — the churn round's
+    padding-tax amortizer.
+
+    The merge operands are intrinsically k lanes wide ([Q, k] carried
+    distance planes + index planes), and TPU tiled layout pads every
+    minor dim to 128 lanes: at the protocol k=8 each elementwise mask /
+    sentinel / sort step moves 16× the useful bytes — measured ~8 ms of
+    the 13.6 ms churny-vs-static gap at 131K queries
+    (benchmarks/exp_churn2_r5.py, VERDICT r5 weak #1).  The standard
+    lane-occupancy trick from batched serving kernels applies because
+    the per-query merges are independent: pack P queries' k-lane planes
+    into one [Q/P, P·k] physical row (P·k = 128 exactly at k=8), pay
+    the pad once per P queries, and keep the merge a single row-wise
+    ``lax.sort`` by prepending a query-slot key — within a packed row
+    the sort groups each query's 2k candidates contiguously and orders
+    them by exactly the comparison the unpacked merge used, so the
+    extracted prefixes are bit-identical for every P (pinned across
+    pack widths, ragged Q, and tombstone densities in
+    tests/test_table_churn.py).  Ragged Q pads the tail with sentinel
+    slots (enc = _ENC_SENT, all-ones distances) that sort behind every
+    real candidate of their slot and are sliced off on unpack.
+
+    Args: ``m_dist``/``d_dist`` carried distance keys — a tuple of nl
+    2-D [Q, k] planes (the fast2_limbs form) or an [Q, k, nl] stack;
+    ``m_idx``/``d_idx`` int32 [Q, k] candidate encodings (-1 invalid,
+    base sorted positions / delta sorted positions); ``n_base`` the
+    base table row count (delta encodings come back offset by it, the
+    churn_lookup_topk contract).
+
+    Returns ``(enc [Q, w], limbs [nl × [Q, w]])`` — the first
+    w = min(k+1, 2k) rows of each query's merged order (k results + one
+    lookahead row for the fast2 tie check), masked lanes carrying
+    _ENC_SENT / all-ones.
+    """
+    Q = m_idx.shape[0]
+    big = jnp.uint32(0xFFFFFFFF)
+    w = min(k + 1, 2 * k)
+    P = int(pack)
+    QB = -(-Q // P)
+    Qp = QB * P
+
+    def _pl(x, l):
+        return x[l] if isinstance(x, (tuple, list)) else x[..., l]
+
+    def pk(x, fill):
+        if Qp != Q:
+            x = jnp.concatenate(
+                [x, jnp.full((Qp - Q, k), fill, x.dtype)], axis=0)
+        return x.reshape(QB, P * k)
+
+    # masking runs on the packed rows: these wheres (and the sort
+    # below) are the ops the [Q, k] layout paid the 128-lane pad on
+    mi = pk(m_idx, jnp.int32(-1))
+    di = pk(d_idx, jnp.int32(-1))
+    mv = mi >= 0
+    dv = di >= 0
+    enc = jnp.concatenate([jnp.where(mv, mi, _ENC_SENT),
+                           jnp.where(dv, di + n_base, _ENC_SENT)], axis=1)
+    limbs = tuple(
+        jnp.concatenate([jnp.where(mv, pk(_pl(m_dist, l), big), big),
+                         jnp.where(dv, pk(_pl(d_dist, l), big), big)],
+                        axis=1)
+        for l in range(nl))
+    if P > 1:
+        # slot-segmented sort: the slot key confines every comparison
+        # to one query's segment, so adding it changes nothing about
+        # the within-query order.  Lanes with fully-equal key tuples
+        # are byte-identical in every operand (the all-ones sentinel),
+        # so the unstable sort cannot change extracted values.
+        slot = jnp.repeat(jnp.arange(P, dtype=jnp.int32), k)
+        slot = jnp.broadcast_to(jnp.concatenate([slot, slot])[None, :],
+                                (QB, 2 * P * k))
+        out = lax.sort((slot,) + limbs + (enc,), dimension=1,
+                       num_keys=nl + 2)[1:]
+    else:
+        out = lax.sort(limbs + (enc,), dimension=1, num_keys=nl + 1)
+
+    def unpk(a):
+        # slot s owns lanes [2k·s, 2k·(s+1)) after the segmented sort
+        return a.reshape(QB, P, 2 * k)[:, :, :w].reshape(Qp, w)[:Q]
+
+    return unpk(out[nl]), [unpk(out[l]) for l in range(nl)]
+
+
 @functools.partial(jax.jit, static_argnames=("k", "select", "lut_steps",
                                              "d_lut_steps", "planes",
-                                             "d_cap"))
+                                             "d_cap", "merge_pack"))
 def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
                       d_sorted, d_expanded, d_n_valid, queries,
                       lut=None, d_lut=None, d_exp_wide=None, *, k: int = 8,
                       select: str = "fast3", lut_steps=None,
                       d_lut_steps=None, planes: int = N_LIMBS,
-                      d_cap: int = 1024):
+                      d_cap: int = 1024, merge_pack="auto"):
     """Exact k XOR-closest over (live base rows ∪ delta slab).
 
     Args: base table as in :func:`expanded_topk` (``expanded`` must use
@@ -994,6 +1098,13 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     ``select="fast3"``/``"sort"`` (full limbs ride the window sorts —
     no extra gathers) and ``None`` for ``"fast2"`` (the
     findClosestNodes contract: nodes, not distances).
+
+    ``merge_pack`` sets the lane-packing width of the final merge
+    (:func:`packed_churn_merge`): ``"auto"`` packs 128//k queries per
+    physical row on TPU (the 128-lane padding-tax amortizer — P=16 at
+    k=8) and resolves to 1 elsewhere (no pad tax to amortize; measured
+    slightly negative on cpu).  Any int ≥ 1 forces that width.
+    Results are bit-identical for every width.
 
     Everything is gather-free past the window row fetches: the merge
     sorts the *carried* distance keys — 6 operands for fast3, 3 for
@@ -1015,12 +1126,6 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     big = jnp.uint32(0xFFFFFFFF)
     fast2 = select == "fast2"
     nl = 2 if fast2 else N_LIMBS
-
-    def _pl(x, l):
-        """Limb plane l of carried distances: fast2 hands a tuple of
-        2-D [Q,k] planes (lane-padding economics — see expanded_topk
-        fast2_limbs), fast3 a [Q,k,5] array."""
-        return x[l] if isinstance(x, tuple) else x[..., l]
 
     m_dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
                                       queries, k=k, select=select, lut=lut,
@@ -1073,30 +1178,26 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     d_idx, dd = lax.cond(jnp.all(d_cert), lambda _: (d_idx, dd),
                          d_exact, operand=None)
 
-    # merge: one sort over 2k candidates per query on the CARRIED
-    # distance keys + a source key.  Invalid lanes get all-ones limbs +
-    # the ENC sentinel; a *real* candidate with an all-ones distance
-    # still wins via the smaller enc key.  Live ids are unique across
-    # base and delta (core/table.py re-adds a revived id to the delta
-    # only while its base position is tombstoned), so full distances
-    # never tie and fast3's 5-limb merge order is exact.
+    # merge: one slot-segmented sort over P packed queries' 2k
+    # candidates per physical row on the CARRIED distance keys + a
+    # source key (packed_churn_merge — the 128-lane padding-tax
+    # amortizer).  Invalid lanes get all-ones limbs + the ENC sentinel;
+    # a *real* candidate with an all-ones distance still wins via the
+    # smaller enc key.  Live ids are unique across base and delta
+    # (core/table.py re-adds a revived id to the delta only while its
+    # base position is tombstoned), so full distances never tie and
+    # fast3's 5-limb merge order is exact.
     m_valid = m_idx >= 0
     d_valid = d_idx >= 0
-    enc_m = jnp.where(m_valid, m_idx, _ENC_SENT)
-    enc_d = jnp.where(d_valid, d_idx + N, _ENC_SENT)
-    limb_ops = tuple(
-        jnp.concatenate([jnp.where(m_valid, _pl(m_dist, l), big),
-                         jnp.where(d_valid, _pl(dd, l), big)], axis=1)
-        for l in range(nl)
-    )
-    enc_all = jnp.concatenate([enc_m, enc_d], axis=1)
-    out = lax.sort(limb_ops + (enc_all,), dimension=1, num_keys=nl + 1)
-    enc_k = out[nl][:, :k]
+    P = _resolve_merge_pack(merge_pack, k)
+    enc_p, limbs_p = packed_churn_merge(m_dist, m_idx, dd, d_idx, N,
+                                        k=k, nl=nl, pack=P)
+    enc_k = enc_p[:, :k]
     ok = enc_k != _ENC_SENT
 
     if not fast2:
         f_idx = jnp.where(ok, enc_k, -1)
-        f_dist = jnp.stack([jnp.where(ok, out[l][:, :k], big)
+        f_dist = jnp.stack([jnp.where(ok, limbs_p[l][:, :k], big)
                             for l in range(nl)], axis=-1)
         return f_dist, f_idx, jnp.ones((Q,), bool)
 
@@ -1104,13 +1205,16 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     # tie among the first k+1 merged rows means the true 160-bit order
     # is undetermined.  Repair by re-merging the same 2k candidates on
     # FULL distances (id gathers live only inside this ~never-taken
-    # branch).
-    kk = min(k + 1, 2 * k)
-    t0, t1, tv = out[0][:, :kk], out[1][:, :kk], out[2][:, :kk] != _ENC_SENT
+    # branch, unpacked — its cost does not matter, its allocation does:
+    # _fallback_tile bounds the rest of the branch family).
+    t0, t1, tv = limbs_p[0], limbs_p[1], enc_p != _ENC_SENT
     tie = jnp.any((t0[:, 1:] == t0[:, :-1]) & (t1[:, 1:] == t1[:, :-1])
                   & tv[:, 1:] & tv[:, :-1])
 
     def exact_merge(_):
+        enc_all = jnp.concatenate(
+            [jnp.where(m_valid, m_idx, _ENC_SENT),
+             jnp.where(d_valid, d_idx + N, _ENC_SENT)], axis=1)
         m_ids = jnp.take(sorted_ids, jnp.clip(m_idx, 0, N - 1).reshape(-1),
                          axis=0).reshape(Q, k, N_LIMBS)
         d_ids = jnp.take(d_sorted, jnp.clip(d_idx, 0, D - 1).reshape(-1),

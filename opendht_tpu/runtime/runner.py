@@ -32,10 +32,13 @@ import threading
 import time as _time
 from typing import Callable, List, Optional, Tuple
 
-from .. import crypto
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
-from ..utils import TIME_MAX
+from ..utils import TIME_MAX, lazy_module
+
+# call-time dependency only (identity handling): lazy so the runner
+# imports and runs identity-less without the `cryptography` wheel
+crypto = lazy_module("opendht_tpu.crypto")
 from ..core.value import Value
 from ..scheduler import Scheduler
 from .config import Config, NodeStatus

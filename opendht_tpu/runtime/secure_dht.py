@@ -25,12 +25,17 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict, List, Optional
 
-from .. import crypto
 from ..infohash import InfoHash
 from ..core.default_types import DEFAULT_INSECURE_TYPES, DEFAULT_TYPES
 from ..core.value import Filters, Value, ValueType, random_value_id
-from ..utils import unpack_msg
+from ..utils import lazy_module, unpack_msg
 from .config import Config, SecureDhtConfig
+
+# call-time dependency only: every crypto touch happens per-value or
+# per-identity, so the module imports (and an identity-less SecureDht
+# runs) without the `cryptography` wheel — certificate policies then
+# reject stores via their existing except-paths instead of crashing
+crypto = lazy_module("opendht_tpu.crypto")
 
 log = logging.getLogger("opendht_tpu.secure")
 

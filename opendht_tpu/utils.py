@@ -42,6 +42,35 @@ def uniform_duration(low: float, high: float, rng: random.Random | None = None) 
     return r
 
 
+def lazy_module(name: str):
+    """Import-on-first-attribute-touch module proxy.
+
+    The crypto layer needs the ``cryptography`` wheel at IMPORT time
+    (x509/serialization bindings), but the runner/SecureDht stack only
+    touches it at CALL time — and only when an identity or certificate
+    is actually in play.  Binding ``crypto = lazy_module(...)`` lets
+    the whole runtime import and run identity-less in minimal
+    containers (the PEP 562 package-level re-exports made the same
+    move for kernels in round 6); the ImportError surfaces on first
+    real use instead.
+    """
+    import importlib
+
+    class _Lazy:
+        def __getattr__(self, attr):
+            # memoize on the proxy: __getattr__ only fires on misses,
+            # so each attribute pays the importlib lookup exactly once
+            # (the proxy sits on SecureDht's per-value hot paths)
+            val = getattr(importlib.import_module(name), attr)
+            setattr(self, attr, val)
+            return val
+
+        def __repr__(self):
+            return f"<lazy module {name!r}>"
+
+    return _Lazy()
+
+
 class DhtException(Exception):
     """Base error for DHT operations (utils.h:63-67)."""
 

@@ -150,6 +150,24 @@ def test_udp_loopback_exempt_from_limits():
         assert b.stats()["dropped_rate"] == 0
 
 
+def test_udp_v6_loopback_exempt_from_limits():
+    """::1 joins the 127/8 rate-limit exemption (local v6 clusters share
+    that source the same way v4 ones share 127.0.0.1)."""
+    with native.UdpEngine(0) as a, \
+            native.UdpEngine(0, per_ip_rps=5, global_rps=5) as b:
+        if not (a.has_v6 and b.has_v6):
+            pytest.skip("no IPv6 on this host")
+        for i in range(40):
+            a.send(b"z%d" % i, ("::1", b.port))
+        deadline = time.monotonic() + 5.0
+        got = []
+        while len(got) < 40 and time.monotonic() < deadline:
+            got.extend(b.poll(max_pkts=64))
+            time.sleep(0.01)
+        assert len(got) == 40
+        assert b.stats()["dropped_rate"] == 0
+
+
 def test_udp_batch_poll():
     with native.UdpEngine(0) as a, native.UdpEngine(0) as b:
         for i in range(20):

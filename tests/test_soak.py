@@ -1,0 +1,210 @@
+"""Wall-clock soak tier (round-5 verdict ask 6, ISSUE 2 satellite).
+
+The reference runs as a long-lived daemon (tools/dhtnode.cpp:480-545);
+before this tier nothing here ran longer than a test.  A real-UDP
+cluster sustains puts/gets/listens while nodes churn (join/leave) for
+``OPENDHT_TPU_SOAK_SECS`` wall seconds (default 60; set it to 600+ for
+the full ≥10-minute soak the verdict asked for), then asserts the
+properties a daemon needs and a functional test cannot see:
+
+- **bounded RSS growth**: the process RSS after warm-up must not keep
+  climbing — leaked values/listeners/partial buffers show up here
+  first (expiry sweeps: src/dht.cpp:1916-1927);
+- **scheduler-queue stability**: lazy-cancelled jobs must not
+  accumulate in any node's heap (opendht_tpu/scheduler.py's lazy
+  deletion relies on the run loop draining stale entries);
+- **listener / partial-buffer cleanup**: after the load stops and
+  listeners are cancelled, every engine's reassembly buffer and
+  listener map must drain (the fuzz tier checks cleanup after
+  timeouts; this checks it under sustained load).
+
+Prints one resource-report line (the verdict's ask) whether or not the
+assertions trip.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import gc
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+# identity-less runners need no `cryptography` wheel (the lazy crypto
+# binding in runtime/runner.py), so the soak runs in minimal containers
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.core.value import Value
+from opendht_tpu.runtime.config import NodeStatus
+from opendht_tpu.runtime.runner import DhtRunner
+
+pytestmark = pytest.mark.slow
+
+SOAK_SECS = float(os.environ.get("OPENDHT_TPU_SOAK_SECS", "60"))
+N_STABLE = 4
+
+
+def _rss_mb() -> float:
+    """Current VmRSS in MiB (Linux procfs; 0.0 when unreadable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def _sched_len(runner: DhtRunner) -> int:
+    return len(runner._dht._dht.scheduler._heap)
+
+
+def _wait(pred, timeout=30.0, step=0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_soak_cluster_resources():
+    runners = []
+
+    def spawn(bootstrap_port=None):
+        r = DhtRunner()
+        r.run(0)
+        if bootstrap_port:
+            r.bootstrap("127.0.0.1", bootstrap_port)
+        runners.append(r)
+        return r
+
+    stats = {"puts": 0, "gets": 0, "listen_hits": 0, "churned": 0,
+             "get_misses": 0, "op_timeouts": 0}
+    rss0 = None
+    sched_max = 0
+    try:
+        hub = spawn()
+        for _ in range(N_STABLE - 1):
+            spawn(hub.get_bound_port())
+        assert _wait(lambda: all(
+            r.get_status() is NodeStatus.CONNECTED for r in runners[1:])), \
+            "cluster never connected"
+
+        # standing listeners on fixed keys — puts during the soak must
+        # keep flowing through them
+        listen_keys = [InfoHash.get(f"soak-listen-{i}") for i in range(3)]
+        tokens = []
+        for i, key in enumerate(listen_keys):
+            tokens.append(runners[1].listen(
+                key, lambda vals, exp: (
+                    stats.__setitem__(
+                        "listen_hits", stats["listen_hits"] + len(vals))
+                    or True)))
+
+        churner = spawn(hub.get_bound_port())
+        rng = np.random.default_rng(17)
+        put_keys: list = []
+
+        gc.collect()
+        warm_end = time.monotonic() + min(10.0, SOAK_SECS * 0.25)
+        t_end = time.monotonic() + SOAK_SECS
+        next_churn = time.monotonic() + max(8.0, SOAK_SECS / 6)
+        i = 0
+        while time.monotonic() < t_end:
+            i += 1
+            key = (listen_keys[i % 3] if i % 5 == 0
+                   else InfoHash.get(f"soak-{i}"))
+            src = runners[1 + (i % (len(runners) - 1))]
+            # futures.TimeoutError is only an alias of the builtin from
+            # 3.11 — catch both so an op stall is data, not a crash
+            try:
+                if src.put_sync(key, Value(b"soak-%d" % i), timeout=20.0):
+                    stats["puts"] += 1
+                    put_keys.append(key)
+            except (TimeoutError, concurrent.futures.TimeoutError):
+                stats["op_timeouts"] += 1
+            if put_keys and i % 3 == 0:
+                k = put_keys[int(rng.integers(0, len(put_keys)))]
+                try:
+                    vals = hub.get_sync(k, timeout=20.0)
+                    stats["gets"] += 1
+                    if not vals:
+                        stats["get_misses"] += 1
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    stats["op_timeouts"] += 1
+            if time.monotonic() >= next_churn:
+                # node churn: retire the churner, join a fresh one
+                churner.join()
+                runners.remove(churner)
+                churner = spawn(hub.get_bound_port())
+                stats["churned"] += 1
+                next_churn = time.monotonic() + max(8.0, SOAK_SECS / 6)
+            now = time.monotonic()
+            if now >= warm_end:
+                if rss0 is None:
+                    gc.collect()
+                    rss0 = _rss_mb()
+                sched_max = max(sched_max, *(
+                    _sched_len(r) for r in runners))
+
+        assert stats["puts"] > 0 and stats["gets"] > 0, \
+            f"soak did no work: {stats}"
+        assert stats["listen_hits"] > 0, "standing listeners never fired"
+
+        # ---- cleanup under load: cancel listeners, let queues settle
+        for key, tok in zip(listen_keys, tokens):
+            runners[1].cancel_listen(key, tok)
+        time.sleep(2.0)
+        gc.collect()
+        rss_end = _rss_mb()
+
+        for r in runners:
+            dht = r._dht._dht
+            # reassembly buffers drain (RX_MAX_PACKET_TIME is 10 s; the
+            # soak's last fragmented value is older than the settle +
+            # the next periodic sweep on any live node)
+            assert _wait(lambda d=dht: len(d.engine._partials) == 0,
+                         timeout=15.0), "partial-message buffer leaked"
+        assert len(runners[1]._listeners) == 0, \
+            "runner listener records leaked after cancel_listen"
+
+        # scheduler heaps scale with LIVE STATE — every stored value
+        # legitimately schedules expiry/republish jobs until it ages
+        # out, so the bound is per stored value (measured ~5-8 heap
+        # entries per put across node count), not a constant: a
+        # constant would fail the advertised ≥10-minute soak on bound
+        # arithmetic while a real leak (cancelled jobs accumulating
+        # super-linearly) still blows the per-op envelope.
+        assert sched_max < 1500 + 20 * stats["puts"], \
+            f"scheduler queues grew super-linearly: max {sched_max} " \
+            f"over {stats['puts']} puts"
+
+        # bounded RSS growth after warm-up, same per-op envelope logic:
+        # stored values own real memory until expiry, so allow a
+        # generous per-put allowance on top of a fixed band (CPU jax
+        # keeps compiling host-scan helpers early on); a per-op leak at
+        # soak rates blows far past it, and the printed report line
+        # makes slow drifts visible across runs.
+        growth = (rss_end - rss0) if (rss0 and rss_end) else 0.0
+        limit = 120.0 + 0.25 * stats["puts"]
+        assert growth < limit, \
+            f"RSS grew {growth:.1f} MiB over the soak (from " \
+            f"{rss0:.1f}, limit {limit:.0f})"
+    finally:
+        report = (f"soak report: {SOAK_SECS:.0f}s, nodes={len(runners)} "
+                  f"(+{stats['churned']} churned), puts={stats['puts']} "
+                  f"gets={stats['gets']} (miss {stats['get_misses']}, "
+                  f"timeouts {stats['op_timeouts']}) "
+                  f"listen_hits={stats['listen_hits']}, "
+                  f"rss {0.0 if rss0 is None else rss0:.0f}->"
+                  f"{_rss_mb():.0f} MiB, sched-q max {sched_max}")
+        print("\n" + report)
+        for r in runners:
+            try:
+                r.join()
+            except Exception:
+                pass

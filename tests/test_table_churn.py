@@ -151,6 +151,76 @@ def test_churn_kernel_empty_base_delta_only():
     np.testing.assert_array_equal(np.asarray(dist), d_ref)
 
 
+@pytest.mark.parametrize("pack", [2, 3, 8, 16])
+def test_packed_merge_bit_identical_sweep(pack):
+    """Lane-packed merge property sweep (round-7 tentpole): for every
+    pack width, the packed merge must be BIT-identical to the unpacked
+    merge_pack=1 path — and both to the brute-force oracle — across
+    ragged Q (107 % pack != 0 for every width here), tombstone density
+    0 / 0.1 / 0.95 / 1.0 (the fully-tombstoned-windows edge), a
+    truncated n_valid edge, both k tiers, and both merge key forms
+    (fast3 full limbs, fast2 top-64 + tie repair)."""
+    (sorted_ids, perm, n_valid), rng = _mk_table(2048, 120,
+                                                 n_valid_frac=0.9)
+    exp = expand_table(sorted_ids)
+    q = K.ids_from_bytes(
+        rng.integers(0, 256, size=(107, 20), dtype=np.uint8))
+    for dens, k, n_delta in ((0.0, 8, 37), (0.1, 8, 37),
+                             (0.95, 16, 5), (1.0, 8, 5)):
+        tomb = rng.random(2048) < dens
+        tomb[int(n_valid):] = False
+        delta = np.zeros((64, 5), np.uint32)
+        delta[:n_delta] = K.ids_from_bytes(
+            rng.integers(0, 256, size=(n_delta, 20), dtype=np.uint8))
+        ds, de, dnv = _delta_dev(delta, n_delta)
+        tb = jnp.asarray(_pack_bits(tomb))
+        qd = jnp.asarray(q)
+
+        d_ref, enc_ref, _ = churn_lookup_topk(
+            sorted_ids, exp, n_valid, tb, ds, de, dnv, qd, k=k,
+            merge_pack=1)
+        d_got, enc_got, cert = churn_lookup_topk(
+            sorted_ids, exp, n_valid, tb, ds, de, dnv, qd, k=k,
+            merge_pack=pack)
+        assert bool(np.asarray(cert).all())
+        np.testing.assert_array_equal(np.asarray(enc_got),
+                                      np.asarray(enc_ref))
+        np.testing.assert_array_equal(np.asarray(d_got),
+                                      np.asarray(d_ref))
+
+        # fast2 (nodes-not-distances contract, 2-key merge + tie check)
+        exp2 = expand_table(sorted_ids, limbs=2)
+        de2 = expand_table(ds, stride=16, limbs=2)
+        dew = expand_table(ds, stride=64, limbs=2)
+        _n, f2_ref, _ = churn_lookup_topk(
+            sorted_ids, exp2, n_valid, tb, ds, de2, dnv, qd, k=k,
+            d_exp_wide=dew, select="fast2", planes=2, merge_pack=1)
+        _n, f2_got, _ = churn_lookup_topk(
+            sorted_ids, exp2, n_valid, tb, ds, de2, dnv, qd, k=k,
+            d_exp_wide=dew, select="fast2", planes=2, merge_pack=pack)
+        np.testing.assert_array_equal(np.asarray(f2_got),
+                                      np.asarray(f2_ref))
+
+        # and the full-materialization oracle over (live base ∪ delta)
+        d_o, ids_o = _oracle(sorted_ids, n_valid, tomb, delta, n_delta,
+                             q, k)
+        assert _churn_ids(sorted_ids, ds, np.asarray(enc_got)) == ids_o
+        np.testing.assert_array_equal(np.asarray(d_got), d_o)
+
+
+def test_merge_pack_rejects_invalid_width():
+    (sorted_ids, _, n_valid), rng = _mk_table(256, 121)
+    exp = expand_table(sorted_ids)
+    delta = np.zeros((64, 5), np.uint32)
+    ds, de, dnv = _delta_dev(delta, 0)
+    q = jnp.asarray(K.ids_from_bytes(
+        rng.integers(0, 256, size=(4, 20), dtype=np.uint8)))
+    with pytest.raises(ValueError, match="merge_pack"):
+        churn_lookup_topk(sorted_ids, exp, n_valid,
+                          jnp.zeros(8, jnp.uint32), ds, de, dnv, q,
+                          k=8, merge_pack=0)
+
+
 def test_tomb_bits_require_aligned_stride():
     """The gather-free word extraction needs window starts on 32-bit
     word boundaries; unaligned strides must refuse loudly."""
