@@ -85,6 +85,17 @@ rc = m.main(["--smoke", "-N", "16384", "-Q", "1025", "--dcap", "1024",
              "-E", "64"])
 assert rc == 0, "churn-merge smoke failed"
 PY
+# telemetry smoke (round 8): boot a small real-UDP cluster, run
+# puts/gets, scrape the proxy's GET /stats and DhtRunner.get_metrics(),
+# assert the exercised counters advanced, the two exports agree, and
+# the Prometheus text exposition parses line-by-line.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.telemetry_smoke import main
+rc = main()
+assert rc == 0, "telemetry smoke failed"
+PY
 # table-sharded iterative mode on a REAL 8-device virtual mesh.  The
 # heredoc (rather than env vars + the module CLI) is deliberate: on
 # hosts that register an accelerator backend via sitecustomize, the

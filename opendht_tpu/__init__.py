@@ -22,10 +22,14 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 - ``tools``      dhtnode / dhtchat / dhtscanner CLI equivalents
 - ``testing``    cluster harness: virtual-clock network, scenario suites, benchmark
 - ``log``        Logger with per-hash filter and console/file/syslog sinks
+- ``telemetry``  unified metrics spine: counters/gauges/histograms + span
+                 timers, exported as JSON (``DhtRunner.get_metrics``) and
+                 Prometheus text (proxy ``GET /stats``)
 """
 
 __version__ = "0.1.0"
 
+from . import telemetry  # noqa: F401  (stdlib-only; safe to import eagerly)
 from .infohash import InfoHash, PkId, random_infohash  # noqa: F401
 from .core.value import Value, ValueType, Query, Select, Where, Filters  # noqa: F401
 from .runtime.config import Config, NodeStats, NodeStatus, SecureDhtConfig  # noqa: F401

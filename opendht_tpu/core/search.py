@@ -590,13 +590,14 @@ def _lookup_engine(gather_planar, lower, n, targets, q_index, q_total,
                      "state_limbs", "compact_after", "compact_cap",
                      "block_mode"),
 )
-def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
-                     k: int = TARGET_NODES, alpha: int = ALPHA,
-                     search_nodes: int = SEARCH_NODES, max_hops: int = 48,
-                     lut=None, state_limbs: int = N_LIMBS,
-                     compact_after: "int | None" = None,
-                     compact_cap: int = 0, block_mode: str = "lut"):
-    """Run Q iterative lookups to convergence against an N-node network.
+def _simulate_lookups_jit(sorted_ids, n_valid, targets, *, seed: int = 0,
+                          k: int = TARGET_NODES, alpha: int = ALPHA,
+                          search_nodes: int = SEARCH_NODES, max_hops: int = 48,
+                          lut=None, state_limbs: int = N_LIMBS,
+                          compact_after: "int | None" = None,
+                          compact_cap: int = 0, block_mode: str = "lut"):
+    """Compiled core of :func:`simulate_lookups` (same contract; the
+    public wrapper adds the host-side telemetry envelope).
 
     Args:
       sorted_ids: uint32 [N, 5], lexicographically sorted network ids
@@ -680,6 +681,60 @@ def simulate_lookups(sorted_ids, n_valid, targets, *, seed: int = 0,
                           block_bounds=(
                               (lambda t0, L: _lut_block_bounds(lut, t0, L))
                               if block_mode == "lut" else None))
+
+
+def _is_tracer(x) -> bool:
+    try:
+        return isinstance(x, jax.core.Tracer)
+    except AttributeError:          # jax moved core — fail open (no
+        return False                # instrumentation, never a crash)
+
+
+def record_wave(out, elapsed_s: float, wave_width: int, *,
+                mode: str = "single") -> None:
+    """Feed one completed search wave into the telemetry spine
+    (ISSUE-3): ``dht_search_wave_seconds`` (the OPEN ≤8 ms 1024-wave
+    p50 bound is exactly this histogram's p50 at width 1024, PARITY.md),
+    per-round latency (wave wall / deepest round — rounds advance in
+    lockstep inside the compiled while_loop, so the per-round figure is
+    the wave quotient, not a per-round host probe), and the wave-width /
+    hops distributions.  Shared by the single-device engine and the
+    tp-sharded twin (``mode="tp"``, parallel/sharded.py)."""
+    from .. import telemetry
+    reg = telemetry.get_registry()
+    reg.histogram("dht_search_wave_seconds", mode=mode).observe(elapsed_s)
+    reg.histogram("dht_search_wave_width", mode=mode).observe(wave_width)
+    hops = np.asarray(out["hops"])
+    reg.histogram("dht_search_hops", mode=mode).observe_many(hops)
+    rounds = int(hops.max()) if hops.size else 0
+    if rounds > 0:
+        reg.histogram("dht_search_round_seconds", mode=mode).observe(
+            elapsed_s / rounds)
+
+
+def simulate_lookups(sorted_ids, n_valid, targets, **kw):
+    """Run Q iterative lookups to convergence — the public entry point;
+    see :func:`_simulate_lookups_jit` for the full argument contract.
+
+    Telemetry envelope over the compiled engine: times the wave with
+    a host-side span (``perf_counter`` around ``block_until_ready``,
+    plus the matching ``jax.profiler.TraceAnnotation``) and records the
+    wave/hops histograms.  Host-side ONLY — the traced computation is
+    byte-for-byte :func:`_simulate_lookups_jit`, so results are
+    bit-identical with telemetry on or off (pinned in
+    tests/test_telemetry.py).  Under an outer trace (e.g. the bench
+    drivers jit a body that calls this) or with the registry disabled,
+    the envelope vanishes and the call degrades to the bare jit —
+    no blocking, no transfers."""
+    from .. import telemetry
+    reg = telemetry.get_registry()
+    if not reg.enabled or _is_tracer(targets) or _is_tracer(sorted_ids):
+        return _simulate_lookups_jit(sorted_ids, n_valid, targets, **kw)
+    with reg.span("dht_search_wave_seconds", record=False) as sp:
+        out = _simulate_lookups_jit(sorted_ids, n_valid, targets, **kw)
+        jax.block_until_ready(out)
+    record_wave(out, sp.elapsed, targets.shape[0], mode="single")
+    return out
 
 
 # ---------------------------------------------------------------------------

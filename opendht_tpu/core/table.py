@@ -33,11 +33,12 @@ from typing import Any, Optional
 import numpy as np
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..infohash import InfoHash
 from ..ops import ids as IK
 from ..ops import radix
-from ..ops.sorted_table import (sort_table, lookup_topk, expand_table,
-                                churn_lookup_topk)
+from ..ops.sorted_table import (_resolve_merge_pack, sort_table, lookup_topk,
+                                expand_table, churn_lookup_topk)
 
 # liveness windows (reference include/opendht/node.h:148-158)
 NODE_GOOD_TIME = 120 * 60.0       # replied within 2 h → good
@@ -52,6 +53,12 @@ DELTA_CAP = 4096                  # churn side-slab capacity (inserts
                                   # absorbed without re-sorting)
 TOMB_MIN = 1024                   # compact when tombstones exceed
 TOMB_FRAC = 16                    # max(TOMB_MIN, n_base // TOMB_FRAC)
+
+# compactions are a first-class perf signal (every full re-sort+re-expand
+# stalls behind a device sort): counted per-process alongside each
+# NodeTable's own ``compactions`` attribute
+_M_COMPACTIONS = telemetry.get_registry().counter(
+    "dht_table_compactions_total")
 
 # Below these sizes closest-node queries run as an exact numpy scan on
 # the host slab instead of a device kernel: a live protocol node's
@@ -229,7 +236,21 @@ class ChurnView:
 
     def lookup(self, queries, *, k: int = TARGET_NODES, window: int = 128):
         """Batched exact k-closest over (live base ∪ delta) — same
-        contract as :meth:`Snapshot.lookup` (``window`` ignored)."""
+        contract as :meth:`Snapshot.lookup` (``window`` ignored).
+
+        Host-side telemetry (ISSUE-3; the kernel itself is untouched):
+        ``dht_churn_lookup_seconds`` spans the whole device call — the
+        OPEN churny/static ≥0.6× bound (PARITY.md) is this histogram's
+        p50 at an 8192 wave vs ``dht_search_wave_seconds`` on a static
+        table; ``dht_churn_lookups_total{pack=}`` records which merge
+        pack path the backend resolves ("auto" → 128//k on TPU, 1
+        elsewhere); tombstone/delta gauges expose the view's churn
+        debt."""
+        reg = telemetry.get_registry()
+        reg.counter("dht_churn_lookups_total",
+                    pack=_resolve_merge_pack("auto", k)).inc()
+        reg.gauge("dht_churn_tombstones").set(self.tomb_count)
+        reg.gauge("dht_churn_delta_rows").set(self.n_delta)
         q = jnp.asarray(queries, jnp.uint32)
         base = self.base
         if base._expanded is None:
@@ -247,10 +268,11 @@ class ChurnView:
             self._d_perm = np.asarray(dp)
             self._dirty_delta = False
         ds, de, dnv = self._dev_delta
-        dist, enc, _ = churn_lookup_topk(
-            base.sorted_ids, base._expanded, base.n_valid,
-            self._dev_tomb, ds, de, dnv, q, k=k)
-        enc = np.asarray(enc)
+        with reg.span("dht_churn_lookup_seconds"):
+            dist, enc, _ = churn_lookup_topk(
+                base.sorted_ids, base._expanded, base.n_valid,
+                self._dev_tomb, ds, de, dnv, q, k=k)
+            enc = np.asarray(enc)           # blocks on the device call
         n = base.sorted_ids.shape[0]
         # enc in [n, n+D) is a *delta sorted position* → slot → slab row
         dslot = self._d_perm[np.clip(enc - n, 0, len(self._d_perm) - 1)]
@@ -345,6 +367,7 @@ class NodeTable:
         if count_compaction and self._churn is not None \
                 and self._churn.pending:
             self.compactions += 1
+            _M_COMPACTIONS.inc()
         self._version += 1
         self._snap = None
         self._churn = None
@@ -388,6 +411,7 @@ class NodeTable:
         self._churn = ChurnView(snap, self._cap, self._delta_cap)
         self._pending_base = None
         self.compactions += 1
+        _M_COMPACTIONS.inc()
         for op, row in pb["mutlog"]:
             if op == "i":
                 if not self._churn.note_insert(row, self._ids[row]):
@@ -727,6 +751,7 @@ class NodeTable:
         # first builds and mask-flavor rebuilds are not compactions
         if self.churn_pending > 0:
             self.compactions += 1
+            _M_COMPACTIONS.inc()
         sorted_ids, perm, n_valid = sort_table(
             jnp.asarray(self._ids), jnp.asarray(m)
         )

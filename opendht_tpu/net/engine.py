@@ -19,6 +19,7 @@ import socket as _socket
 from dataclasses import dataclass, field as _field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import telemetry
 from ..infohash import InfoHash
 from ..rate_limiter import RateLimiter
 from ..scheduler import Scheduler
@@ -185,6 +186,29 @@ class NetworkEngine:
         self._rate_limiter = RateLimiter(self.max_req_per_sec)
         self._ip_limiters: Dict[tuple, RateLimiter] = {}  # keyed by ip only
         self._limiter_maintenance = 0
+        # telemetry: the registry mirrors of the MessageStats island
+        # (counters labeled by direction+type) plus the send-side request
+        # lifecycle; handles cached — one dict lookup per packet
+        reg = telemetry.get_registry()
+        self._m_msgs: Dict[tuple, telemetry.Counter] = {
+            (d, t): reg.counter("dht_net_messages_total", direction=d, type=t)
+            for d in ("in", "out")
+            for t in ("ping", "find", "get", "put", "listen", "refresh")}
+        self._m_ratelimit_drops = reg.counter("dht_net_ratelimit_drops_total")
+        self._m_sent: Dict[object, telemetry.Counter] = {}
+        self._m_timeouts = reg.counter("dht_net_request_timeouts_total")
+
+    def _count_msg(self, direction: str, mtype: str) -> None:
+        c = self._m_msgs.get((direction, mtype))
+        if c is not None:
+            c.inc()
+
+    def _count_sent(self, req: Request) -> None:
+        c = self._m_sent.get(req.type)
+        if c is None:
+            c = self._m_sent[req.type] = telemetry.get_registry().counter(
+                "dht_net_requests_sent_total", type=req.type.value)
+        c.inc()
 
     # ------------------------------------------------------------------ util
     def _header(self, body_key: str, body: dict, y: str, tid: int,
@@ -254,6 +278,7 @@ class NetworkEngine:
             self.requests[req.tid] = req
         req.start = self.scheduler.time()
         req.node.requested(req)
+        self._count_sent(req)
         self._request_step(req)
 
     def _request_step(self, req: Request) -> None:
@@ -278,6 +303,11 @@ class NetworkEngine:
                 self.requests.pop(req.tid, None)
         else:
             if err != _EAGAIN:
+                if req.attempt_count >= 1:
+                    # a real retransmission: the previous attempt timed
+                    # out (counting here, not at step entry, so EAGAIN
+                    # reschedules of the SAME attempt count once)
+                    self._m_timeouts.inc()
                 req.attempt_count += 1
             req.last_try = now
             self.scheduler.add(req.last_try + MAX_RESPONSE_TIME,
@@ -334,6 +364,7 @@ class NetworkEngine:
         if msg.id == self.myid or not msg.id:
             return          # self-message
         if msg.type in REQUEST_TYPES and not self._rate_limit(from_addr):
+            self._m_ratelimit_drops.inc()
             return
 
         if not msg.value_parts:
@@ -436,10 +467,12 @@ class NetworkEngine:
             self.cb.on_new_node(node, 1)
         if msg.type is MessageType.PING:
             self.in_stats.ping += 1
+            self._count_msg("in", "ping")
             self.cb.on_ping(node)
             self.send_pong(from_addr, msg.tid)
         elif msg.type is MessageType.FIND_NODE:
             self.in_stats.find += 1
+            self._count_msg("in", "find")
             answer = self.cb.on_find_node(node, msg.target, msg.want)
             n4, n6 = self.buffer_nodes(from_addr.family, msg.target, msg.want,
                                        answer.nodes4, answer.nodes6)
@@ -447,6 +480,7 @@ class NetworkEngine:
                                    answer.ntoken)
         elif msg.type is MessageType.GET_VALUES:
             self.in_stats.get += 1
+            self._count_msg("in", "get")
             answer = self.cb.on_get_values(node, msg.info_hash, msg.want,
                                            msg.query)
             n4, n6 = self.buffer_nodes(from_addr.family, msg.info_hash,
@@ -455,6 +489,7 @@ class NetworkEngine:
                                    msg.query, answer.ntoken)
         elif msg.type is MessageType.ANNOUNCE_VALUE:
             self.in_stats.put += 1
+            self._count_msg("in", "put")
             self.cb.on_announce(node, msg.info_hash, msg.token, msg.values,
                                 msg.created)
             # if the store failed we still confirm, to stop backtracking
@@ -463,10 +498,12 @@ class NetworkEngine:
                 self.send_value_announced(from_addr, msg.tid, v.id)
         elif msg.type is MessageType.REFRESH:
             self.in_stats.refresh += 1
+            self._count_msg("in", "refresh")
             self.cb.on_refresh(node, msg.info_hash, msg.token, msg.value_id)
             self.send_value_announced(from_addr, msg.tid, msg.value_id)
         elif msg.type is MessageType.LISTEN:
             self.in_stats.listen += 1
+            self._count_msg("in", "listen")
             self.cb.on_listen(node, msg.info_hash, msg.token, msg.socket_id,
                               msg.query)
             self.send_listen_confirmation(from_addr, msg.tid)
@@ -544,6 +581,7 @@ class NetworkEngine:
                       on_expired)
         self._send_request(req)
         self.out_stats.ping += 1
+        self._count_msg("out", "ping")
         return req
 
     def send_find_node(self, node: Node, target: InfoHash, want: int = -1,
@@ -559,6 +597,7 @@ class NetworkEngine:
                       on_expired)
         self._send_request(req)
         self.out_stats.find += 1
+        self._count_msg("out", "find")
         return req
 
     def send_get_values(self, node: Node, info_hash: InfoHash, query: Query,
@@ -576,6 +615,7 @@ class NetworkEngine:
                       on_expired)
         self._send_request(req)
         self.out_stats.get += 1
+        self._count_msg("out", "get")
         return req
 
     def send_listen(self, node: Node, info_hash: InfoHash, query: Query,
@@ -602,6 +642,7 @@ class NetworkEngine:
                       on_expired, socket_id=sid)
         self._send_request(req)
         self.out_stats.listen += 1
+        self._count_msg("out", "listen")
         return req
 
     def send_announce_value(self, node: Node, info_hash: InfoHash, value: Value,
@@ -626,6 +667,7 @@ class NetworkEngine:
         if parts:
             self._send_value_parts(tid, parts, node.addr)
         self.out_stats.put += 1
+        self._count_msg("out", "put")
         return req
 
     def send_refresh_value(self, node: Node, info_hash: InfoHash, vid: int,
@@ -643,6 +685,7 @@ class NetworkEngine:
                       done if on_done else None, on_expired)
         self._send_request(req)
         self.out_stats.refresh += 1
+        self._count_msg("out", "refresh")
         return req
 
     # ------------------------------------------------------------ tx: replies

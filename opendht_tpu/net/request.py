@@ -3,13 +3,23 @@
 PENDING → COMPLETED (reply matched by tid) | EXPIRED (3 attempts × 1 s
 timed out) | CANCELLED.  ``on_expired(req, done)`` fires once with
 done=False after the first re-attempt (early hint used to solicit other
-candidates) and once with done=True on final expiry."""
+candidates) and once with done=True on final expiry.
+
+Every terminal transition feeds the telemetry spine: completion counts
+into ``dht_net_requests_completed_total{type=}`` with the request's RTT
+observed into ``dht_net_rtt_seconds{type=}`` (reply_time − start, both
+stamped by the engine on scheduler time), expiry into
+``dht_net_requests_expired_total{type=}``, cancellation into
+``dht_net_requests_cancelled_total{type=}``.  The matching send-side
+counters (sent / per-attempt timeouts) live in
+:mod:`~opendht_tpu.net.engine`."""
 
 from __future__ import annotations
 
 import enum
-from typing import TYPE_CHECKING, Callable, Optional
+from typing import TYPE_CHECKING, Callable, Dict, Optional
 
+from .. import telemetry
 from .node import MAX_RESPONSE_TIME, Node
 
 if TYPE_CHECKING:
@@ -18,6 +28,25 @@ if TYPE_CHECKING:
 MAX_ATTEMPT_COUNT = 3           # request.h:108
 
 _NEVER = float("-inf")
+
+# metric handles cached per (event, message-type): the lifecycle runs
+# once per RPC, but a busy node retires thousands of RPCs per second —
+# the registry's get-or-create lock stays off that path
+_m_cache: Dict[tuple, object] = {}
+
+
+def _metric(kind: str, name: str, mtype: "MessageType"):
+    key = (name, mtype)
+    m = _m_cache.get(key)
+    if m is None:
+        reg = telemetry.get_registry()
+        # the wire name ("put"/"get"/...) — matches the type labels the
+        # engine's dht_net_messages_total counters use
+        label = mtype.value if hasattr(mtype, "value") else str(mtype)
+        m = (reg.histogram(name, type=label) if kind == "histogram"
+             else reg.counter(name, type=label))
+        _m_cache[key] = m
+    return m
 
 
 class RequestState(enum.Enum):
@@ -84,6 +113,8 @@ class Request:
     def set_expired(self) -> None:
         if self.pending:
             self.state = RequestState.EXPIRED
+            _metric("counter", "dht_net_requests_expired_total",
+                    self.type).inc()
             if self.on_expired:
                 self.on_expired(self, True)
             self._clear()
@@ -91,6 +122,11 @@ class Request:
     def set_done(self, msg: "ParsedMessage") -> None:
         if self.pending:
             self.state = RequestState.COMPLETED
+            _metric("counter", "dht_net_requests_completed_total",
+                    self.type).inc()
+            if self.reply_time != _NEVER and self.start != _NEVER:
+                _metric("histogram", "dht_net_rtt_seconds", self.type) \
+                    .observe(max(self.reply_time - self.start, 0.0))
             if self.on_done:
                 self.on_done(self, msg)
             self._clear()
@@ -98,6 +134,8 @@ class Request:
     def cancel(self) -> None:
         if self.pending:
             self.state = RequestState.CANCELLED
+            _metric("counter", "dht_net_requests_cancelled_total",
+                    self.type).inc()
             self._clear()
 
     def close_socket(self) -> int:

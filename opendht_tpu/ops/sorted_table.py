@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import telemetry
 from .ids import N_LIMBS, xor_ids, common_bits, clz32
 from .xor_topk import xor_topk
 
@@ -976,7 +977,11 @@ def _resolve_merge_pack(pack, k: int) -> int:
     (16.6 ms vs ~1.6 ms over the no-merge variant; −15 ms ≈ −3.5% at
     the whole-round level — captures/churn_packed.json), the same
     backend split window_topk's ``select="auto"`` makes.  Any int ≥ 1
-    is valid — P=1 is the unpacked merge."""
+    is valid — P=1 is the unpacked merge.  Pure resolution — the
+    telemetry lives at the jit boundary (``churn_lookup_topk`` counts
+    ``dht_churn_merge_pack_resolved_total{pack=}`` once per trace, so
+    that counter records which pack paths got COMPILED this process;
+    the per-call path counter is core/table.ChurnView.lookup's)."""
     if pack == "auto":
         return (max(1, 128 // k)
                 if jax.default_backend() == "tpu" else 1)
@@ -1190,6 +1195,10 @@ def churn_lookup_topk(sorted_ids, expanded, n_valid, tomb_bits,
     m_valid = m_idx >= 0
     d_valid = d_idx >= 0
     P = _resolve_merge_pack(merge_pack, k)
+    # trace-time (runs once per compilation of this shape): record which
+    # pack path got compiled
+    telemetry.get_registry().counter(
+        "dht_churn_merge_pack_resolved_total", pack=P).inc()
     enc_p, limbs_p = packed_churn_merge(m_dist, m_idx, dd, d_idx, N,
                                         k=k, nl=nl, pack=P)
     enc_k = enc_p[:, :k]

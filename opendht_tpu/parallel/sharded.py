@@ -443,8 +443,21 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
                                 NamedSharding(mesh, P("t", None)))
     targets = jax.device_put(jnp.asarray(targets, _U32),
                              NamedSharding(mesh, P("q", None)))
-    return fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
-              jnp.asarray(seed, jnp.int32))
+    from .. import telemetry
+    reg = telemetry.get_registry()
+    if not reg.enabled:
+        return fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
+                  jnp.asarray(seed, jnp.int32))
+    # same host-side envelope as the single-device entry (core/search.py
+    # simulate_lookups): the traced computation is untouched, the span
+    # blocks and the wave/hops series land under mode="tp"
+    with reg.span("dht_search_wave_seconds", record=False) as sp:
+        out = fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
+                 jnp.asarray(seed, jnp.int32))
+        jax.block_until_ready(out)
+    from ..core.search import record_wave
+    record_wave(out, sp.elapsed, Q, mode="tp")
+    return out
 
 
 def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):

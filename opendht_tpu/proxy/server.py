@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
 
+from .. import telemetry
 from ..infohash import InfoHash
 from ..core.value import Value
 from .json_codec import value_to_json, value_from_json, permanent_deadline
@@ -148,6 +149,7 @@ class DhtProxyServer:
     # ------------------------------------------------------------- internal
     def _count_request(self) -> None:
         now = time.monotonic()
+        telemetry.get_registry().counter("dht_proxy_requests_total").inc()
         with self._lock:
             self.stats.total_requests += 1
             self._req_times.append(now)
@@ -155,6 +157,27 @@ class DhtProxyServer:
             while self._req_times and self._req_times[0] < cutoff:
                 self._req_times.pop(0)
             self.stats.request_rate = len(self._req_times) / 60.0
+
+    def prometheus_stats(self) -> str:
+        """Text exposition for ``GET /stats`` (ISSUE-3: the reference's
+        ``STATS /`` server-stats island joined to the unified registry).
+        Refreshes the ServerStats gauges and — when the runner exposes
+        ``get_metrics`` — the routing-table gauges, then dumps the whole
+        process registry."""
+        reg = telemetry.get_registry()
+        with self._lock:
+            reg.gauge("dht_proxy_listen_count").set(self.stats.listen_count)
+            reg.gauge("dht_proxy_put_count").set(self.stats.put_count)
+            reg.gauge("dht_proxy_push_listeners").set(
+                self.stats.push_listeners_count)
+            reg.gauge("dht_proxy_request_rate").set(self.stats.request_rate)
+        get_metrics = getattr(self._runner, "get_metrics", None)
+        if get_metrics is not None:
+            try:
+                get_metrics()        # refresh dht_routing_* gauges
+            except Exception:
+                pass
+        return reg.prometheus()
 
     def _node_info(self) -> dict:
         """GET / payload (dht_proxy_server.cpp:206-232)."""
@@ -322,6 +345,20 @@ def _make_handler(server: DhtProxyServer):
             parts, _q = self._parse()
             if not parts:                      # GET / → node info (:206-232)
                 self._send_json(server._node_info())
+                return
+            if parts == ["stats"]:
+                # GET /stats → Prometheus text exposition of the unified
+                # telemetry registry (ISSUE-3; extends the reference's
+                # STATS / JSON route — "stats" is not a valid hash, so
+                # the path was previously a 400 and stays unambiguous)
+                body = server.prometheus_stats().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             key = self._hash_arg(parts)
             if key is None:

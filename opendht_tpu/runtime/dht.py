@@ -615,7 +615,8 @@ class Dht:
                         if vals:
                             get.get_cb(vals)
         else:
-            log.warning("[node %s] no token provided; blacklisting", node.id)
+            log.warning("[node %s] no token provided; blacklisting", node.id,
+                        extra={"dht_hash": bytes(node.id)})
             self.engine.blacklist_node(node)
 
         if not sr.done:
@@ -1289,7 +1290,8 @@ class Dht:
         """(↔ Dht::onError, src/dht.cpp:2089-2111)"""
         node = req.node
         if e.code == DhtProtocolException.UNAUTHORIZED:
-            log.warning("[node %s] token flush", node.id)
+            log.warning("[node %s] token flush", node.id,
+                        extra={"dht_hash": bytes(node.id)})
             node.auth_error()
             node.cancel_request(req)
             table = self._table(node.family)
@@ -1666,7 +1668,8 @@ class Dht:
                     created_wall, packed = item
                     v = Value.from_packed(packed)
                 except Exception:
-                    log.exception("failed to import value for %s", key)
+                    log.exception("failed to import value for %s", key,
+                                  extra={"dht_hash": bytes(key)})
                     continue
                 created = min(now, created_wall - _wall_offset())
                 self.storage_store(key, v, created)

@@ -32,6 +32,7 @@ import threading
 import time as _time
 from typing import Callable, List, Optional, Tuple
 
+from .. import telemetry
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
 from ..utils import TIME_MAX, lazy_module
@@ -51,6 +52,32 @@ RX_QUEUE_MAX_SIZE = 1024 * 16          # dhtrunner.cpp:45
 RX_QUEUE_MAX_DELAY = 0.5               # dhtrunner.cpp:414-418
 BOOTSTRAP_PERIOD = 10.0                # dhtrunner.h:409
 MAX_PACKET = 1500
+
+
+def _op_metrics_cb(op: str, done_cb):
+    """Wrap a public-API done callback with the per-op telemetry
+    (ISSUE-3 request lifecycle, user view): latency from enqueue to the
+    done callback — queue wait included, that IS the latency an embedder
+    observes — into ``dht_op_seconds{op=}`` and the outcome into
+    ``dht_ops_total{op=,ok=}``.  Multi-callback ops (a get retrying on
+    both families) only time the first completion."""
+    reg = telemetry.get_registry()
+    if not reg.enabled:
+        return done_cb
+    t0 = _time.perf_counter()
+    fired = []
+
+    def wrapped(ok, *args, **kw):
+        if not fired:
+            fired.append(True)
+            reg.histogram("dht_op_seconds", op=op).observe(
+                _time.perf_counter() - t0)
+            reg.counter("dht_ops_total", op=op,
+                        ok="true" if ok else "false").inc()
+        if done_cb:
+            return done_cb(ok, *args, **kw)
+
+    return wrapped
 
 
 class RunnerConfig:
@@ -456,6 +483,7 @@ class DhtRunner:
     def get(self, key: InfoHash, get_cb=None, done_cb=None, f=None,
             where=None) -> None:
         """(dhtrunner.cpp:610-620)"""
+        done_cb = _op_metrics_cb("get", done_cb)
         self._post(lambda dht: dht.get(key, get_cb, done_cb, f, where))
 
     def get_sync(self, key: InfoHash, timeout: Optional[float] = 30.0,
@@ -474,6 +502,7 @@ class DhtRunner:
     def put(self, key: InfoHash, value: Value, done_cb=None,
             created: Optional[float] = None, permanent: bool = False) -> None:
         """(dhtrunner.cpp:727-750)"""
+        done_cb = _op_metrics_cb("put", done_cb)
         self._post(lambda dht: dht.put(key, value, done_cb, created,
                                        permanent))
 
@@ -488,10 +517,12 @@ class DhtRunner:
 
     def put_signed(self, key: InfoHash, value: Value, done_cb=None,
                    permanent: bool = False) -> None:
+        done_cb = _op_metrics_cb("put_signed", done_cb)
         self._post(lambda dht: dht.put_signed(key, value, done_cb, permanent))
 
     def put_encrypted(self, key: InfoHash, to: InfoHash, value: Value,
                       done_cb=None, permanent: bool = False) -> None:
+        done_cb = _op_metrics_cb("put_encrypted", done_cb)
         self._post(lambda dht: dht.put_encrypted(key, to, value, done_cb,
                                                  permanent))
 
@@ -528,6 +559,11 @@ class DhtRunner:
                 return True
             return cb(out, expired)
 
+        # base callback is a no-op so listen_done stays callable even
+        # when the registry is disabled (_op_metrics_cb passes the base
+        # through untouched in that case)
+        listen_done = _op_metrics_cb("listen", lambda ok, *a, **kw: None)
+
         def op(dht):
             backend_token = dht.listen(key, wrapped_cb, f, where)
             with self._listeners_lock:
@@ -538,6 +574,8 @@ class DhtRunner:
                     "backend_token": backend_token,
                     "on_proxy": self.use_proxy,
                 }
+            # registration latency (enqueue → backend token issued)
+            listen_done(backend_token is not None)
             fut.set_result(token)
 
         self._post(op)
@@ -660,6 +698,26 @@ class DhtRunner:
         self._post(lambda dht: fut.set_result(dht.get_nodes_stats(af)),
                    prio=True)
         return fut.result(10.0)
+
+    def get_metrics(self) -> dict:
+        """JSON snapshot of the unified telemetry registry (ISSUE-3) —
+        the SAME registry the proxy's ``GET /stats`` route serves as
+        Prometheus text.  Refreshes the routing-table health gauges
+        (``dht_routing_*{family=}`` — the ``get_nodes_stats`` island
+        folded into the spine, ↔ Dht::getNodesStats) before dumping, so
+        a scrape always sees current table state alongside the
+        cumulative counters/histograms."""
+        reg = telemetry.get_registry()
+        if self.running and self._dht is not None:
+            for af, fam in ((_socket.AF_INET, "ipv4"),
+                            (_socket.AF_INET6, "ipv6")):
+                try:
+                    st = self.get_node_stats(af)
+                except Exception:
+                    continue
+                for field, v in st.to_dict().items():
+                    reg.gauge("dht_routing_" + field, family=fam).set(v)
+        return reg.snapshot()
 
     def get_node_message_stats(self, incoming: bool = False) -> list:
         """[ping, find, get, listen, put] counters

@@ -136,7 +136,8 @@ class SecureDht:
                 # it so the signature can actually be checked
                 self._parse_owner(v)
                 if v.owner is None or not v.check_signature():
-                    log.warning("signature verification failed for %s", key)
+                    log.warning("signature verification failed for %s", key,
+                                extra={"dht_hash": bytes(key)})
                     return False
             return base_store(key, v, nid, addr)
 
@@ -147,10 +148,12 @@ class SecureDht:
             self._parse_owner(n)
             if o.owner is None or n.owner is None \
                     or o.owner.export_der() != n.owner.export_der():
-                log.warning("edition forbidden: owner changed")
+                log.warning("edition forbidden: owner changed",
+                            extra={"dht_hash": bytes(key)})
                 return False
             if not o.owner.check_signature(n.get_to_sign(), n.signature):
-                log.warning("edition forbidden: signature verification failed")
+                log.warning("edition forbidden: signature verification failed",
+                            extra={"dht_hash": bytes(key)})
                 return False
             if o.seq == n.seq:
                 # identical data may be re-announced, possibly by others
@@ -191,7 +194,8 @@ class SecureDht:
             return None
         if crt.get_id() != cert_or_node:
             log.debug("certificate %s does not match node id %s",
-                      crt.get_id(), cert_or_node)
+                      crt.get_id(), cert_or_node,
+                      extra={"dht_hash": bytes(InfoHash(cert_or_node))})
             return None
         self.node_certificates[crt.get_id()] = crt
         return crt

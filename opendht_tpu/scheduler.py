@@ -8,7 +8,18 @@ wakeup so the owning loop can sleep exactly that long.
 Python-idiomatic design: a heapq of (time, seq, Job) entries with lazy
 deletion — ``cancel``/``edit`` just drop the callable, and stale heap
 entries are skipped when popped (the reference reschedules by re-emplacing
-into a multimap, same effect).
+into a multimap, same effect).  Lazy deletion alone lets a cancel-heavy
+workload (listen churn, request retries racing replies) grow the heap
+without bound, so the scheduler counts its stale entries (exposed as the
+``dht_scheduler_stale_entries`` gauge) and compacts the heap in place
+once more than half of a non-trivial heap is dead.
+
+Telemetry (one gauge store / histogram observe per ``run()``, handles
+cached at construction): ``dht_scheduler_queue_depth`` /
+``dht_scheduler_stale_entries`` gauges, ``dht_scheduler_tick_lag_seconds``
+(how late the due job at the head fired — the ISSUE-3 tick-lag surface)
+and ``dht_scheduler_heap_compactions_total``.  Multiple schedulers in
+one process share the series (last writer wins on the gauges).
 """
 
 from __future__ import annotations
@@ -18,7 +29,12 @@ import itertools
 import time as _time
 from typing import Callable, Optional
 
+from . import telemetry
 from .utils import TIME_MAX
+
+#: compaction policy: rebuild when the heap is beyond this size AND more
+#: than half of it is cancelled entries
+_COMPACT_MIN = 64
 
 
 class Job:
@@ -26,14 +42,20 @@ class Job:
     ``time`` tracks the pending fire time (None once popped/parked) so
     callers can compare against an intended reschedule."""
 
-    __slots__ = ("func", "time")
+    __slots__ = ("func", "time", "_sched")
 
     def __init__(self, func: Optional[Callable[[], None]]):
         self.func = func
         self.time: Optional[float] = None
+        self._sched: "Scheduler | None" = None
 
     def cancel(self) -> None:
-        self.func = None
+        if self.func is not None:
+            self.func = None
+            # tell the owning scheduler its heap entry went stale so the
+            # lazy-deletion debt is observable (and compactable)
+            if self._sched is not None and self.time is not None:
+                self._sched._note_stale()
 
     @property
     def cancelled(self) -> bool:
@@ -46,6 +68,41 @@ class Scheduler:
         self._now = clock()
         self._heap: list[tuple[float, int, Job]] = []
         self._seq = itertools.count()
+        self._stale = 0                 # cancelled entries still heaped
+        reg = telemetry.get_registry()
+        self._m_depth = reg.gauge("dht_scheduler_queue_depth")
+        self._m_stale = reg.gauge("dht_scheduler_stale_entries")
+        self._m_lag = reg.histogram("dht_scheduler_tick_lag_seconds")
+        self._m_compactions = reg.counter(
+            "dht_scheduler_heap_compactions_total")
+
+    # -- stale accounting --------------------------------------------------
+    def _note_stale(self) -> None:
+        self._stale += 1
+
+    def _note_popped(self, job: Job) -> None:
+        """A heap entry left the heap; if its job was cancelled it was
+        part of the stale debt.  Clamped: a job double-queued via
+        ``queue()`` owns several entries but counts one stale — the
+        periodic compaction re-zeroes the count exactly."""
+        if job.cancelled and self._stale > 0:
+            self._stale -= 1
+
+    @property
+    def stale_entries(self) -> int:
+        """Cancelled jobs still occupying heap slots (lazy deletion)."""
+        return self._stale
+
+    def _maybe_compact(self) -> None:
+        """Drop cancelled entries in place when they dominate the heap —
+        bounds heap growth under cancel-heavy workloads (the regression
+        tests pin this; see ISSUE-3 satellite)."""
+        heap = self._heap
+        if len(heap) > _COMPACT_MIN and 2 * self._stale > len(heap):
+            self._heap = [e for e in heap if not e[2].cancelled]
+            heapq.heapify(self._heap)
+            self._stale = 0
+            self._m_compactions.inc()
 
     # -- queue ops ---------------------------------------------------------
     def add(self, t: float, func: Callable[[], None]) -> Job:
@@ -53,6 +110,7 @@ class Scheduler:
         (scheduler.h:53-58). t == TIME_MAX means 'parked': the job exists
         but is not queued."""
         job = Job(func)
+        job._sched = self
         if t != TIME_MAX:
             job.time = t
             heapq.heappush(self._heap, (t, next(self._seq), job))
@@ -61,6 +119,7 @@ class Scheduler:
     def queue(self, job: Job, t: float) -> None:
         """Re-enqueue an existing job at ``t`` (scheduler.h:60-63)."""
         if t != TIME_MAX:
+            job._sched = self
             job.time = t
             heapq.heappush(self._heap, (t, next(self._seq), job))
 
@@ -71,6 +130,8 @@ class Scheduler:
         if job is None:
             return None
         func = job.func
+        if func is not None and job.time is not None:
+            self._note_stale()      # the old heap entry is now dead weight
         job.func = None
         job.time = None
         return self.add(t, func) if func is not None else None
@@ -83,6 +144,15 @@ class Scheduler:
         itself for 'now + d' cannot starve the loop."""
         self.sync_time()
         heap = self._heap
+        # drop cancelled heads first so the lag observation below never
+        # reports lateness for a job that was never going to fire
+        while heap and heap[0][2].cancelled:
+            self._note_popped(heap[0][2])
+            heapq.heappop(heap)
+        if heap and heap[0][0] <= self._now:
+            # tick lag: how late the head job fires relative to its
+            # requested time point (scheduler health under load)
+            self._m_lag.observe(self._now - heap[0][0])
         # Snapshot the due entries first: a job that re-adds itself for
         # "now" during this sweep waits for the next run() instead of
         # spinning the loop (the reference relies on real time advancing
@@ -90,6 +160,7 @@ class Scheduler:
         due = []
         while heap and heap[0][0] <= self._now:
             t, _, job = heapq.heappop(heap)
+            self._note_popped(job)
             job.time = None
             due.append((t, job))
         try:
@@ -103,11 +174,17 @@ class Scheduler:
             # heap instead of being silently lost with the local list.
             for t, job in due:
                 heapq.heappush(heap, (t, next(self._seq), job))
+                if job.cancelled:
+                    self._stale += 1
+        self._maybe_compact()
+        self._m_depth.set(len(self._heap))
+        self._m_stale.set(self._stale)
         return self.next_job_time()
 
     def next_job_time(self) -> float:
         heap = self._heap
         while heap and heap[0][2].cancelled:
+            self._note_popped(heap[0][2])
             heapq.heappop(heap)
         return heap[0][0] if heap else TIME_MAX
 

@@ -12,10 +12,19 @@ forever like the reference) and ``--local`` spins up a private two-node
 network instead of joining a public bootstrap, so the tool is runnable
 in sealed environments and tests.
 
+Telemetry (ISSUE-3): every key's put→listen round trip is observed into
+the ``dht_monitor_roundtrip_seconds`` histogram of the unified registry,
+and each round reports the cumulative p50/p95 from that histogram — not
+just the last round's wall time.  Alerting is configurable per
+percentile: ``--alert p95=2.5`` (repeatable) exits non-zero as soon as
+the cumulative percentile crosses the threshold, so one flag drives
+pager policy off whichever tail matters.
+
 Usage::
 
     python -m opendht_tpu.testing.network_monitor --local -n 4 --rounds 3
-    python -m opendht_tpu.testing.network_monitor -b host:port -p 60
+    python -m opendht_tpu.testing.network_monitor -b host:port -p 60 \
+        --alert p50=1.0 --alert p95=5.0
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ import threading
 import time
 from datetime import datetime
 
+from .. import telemetry
 from ..infohash import InfoHash
 from ..core.value import Value
 from ..runtime.config import NodeStatus
@@ -49,8 +59,10 @@ class Monitor:
             self.node1.bootstrap(host, port)
             self.node2.bootstrap(host, port)
         self.keys = [InfoHash.get_random() for _ in range(num_ops)]
-        self.pending: dict = {}          # key-hex -> expected Value
+        self.pending: dict = {}          # key-hex -> (expected Value, t_put)
         self._cv = threading.Condition()
+        self.rtt = telemetry.get_registry().histogram(
+            "dht_monitor_roundtrip_seconds")
         for key in self.keys:
             self.node1.listen(key, self._make_cb(key))
 
@@ -61,9 +73,12 @@ class Monitor:
             if expired:
                 return True
             with self._cv:
-                exp = self.pending.get(kstr)
-                if exp is not None and any(v.id == exp.id for v in values):
+                ent = self.pending.get(kstr)
+                if ent is not None and any(v.id == ent[0].id for v in values):
                     self.pending.pop(kstr, None)
+                    # per-key round trip → the histogram the round
+                    # report and --alert percentiles read from
+                    self.rtt.observe(time.monotonic() - ent[1])
                     self._cv.notify_all()
             return True
         return cb
@@ -86,7 +101,7 @@ class Monitor:
             for i, key in enumerate(self.keys):
                 val = Value(InfoHash.get_random().hex().encode(),
                             value_id=int(start * 1000) * 1000 + i + 1)
-                self.pending[key.hex()] = val
+                self.pending[key.hex()] = (val, time.monotonic())
                 self.node2.put(key, val, lambda ok, nodes: None)
             while self.pending:
                 remaining = self.timeout - (time.monotonic() - start)
@@ -97,9 +112,29 @@ class Monitor:
                                        % (len(missing), missing[:4]))
         return time.monotonic() - start
 
+    def percentiles(self, pcts=(50, 95)) -> dict:
+        """Cumulative put→listen round-trip percentiles (seconds) from
+        the ``dht_monitor_roundtrip_seconds`` histogram."""
+        return {p: self.rtt.quantile(p / 100.0) for p in pcts}
+
     def close(self) -> None:
         self.node1.join()
         self.node2.join()
+
+
+def parse_alerts(specs) -> dict:
+    """``["p95=2.5", "50=1"]`` → {95: 2.5, 50: 1.0}; raises ValueError
+    on malformed specs or percentiles outside (0, 100)."""
+    out: dict = {}
+    for spec in specs or ():
+        name, _, thr = spec.partition("=")
+        if not thr:
+            raise ValueError("alert spec %r is not PCT=SECONDS" % spec)
+        p = float(name.lstrip("pP"))
+        if not 0 < p < 100:
+            raise ValueError("alert percentile %r outside (0, 100)" % name)
+        out[p] = float(thr)
+    return out
 
 
 def main(argv=None) -> int:
@@ -117,7 +152,16 @@ def main(argv=None) -> int:
                    help="stop after N rounds (0 = forever)")
     p.add_argument("--local", action="store_true",
                    help="run against a private 2-node network")
+    p.add_argument("--alert", action="append", default=[], metavar="PCT=SEC",
+                   help="exit non-zero when the cumulative round-trip "
+                        "percentile exceeds SEC (e.g. --alert p95=2.5; "
+                        "repeatable, one threshold per percentile)")
     args = p.parse_args(argv)
+    try:
+        alerts = parse_alerts(args.alert)
+    except ValueError as e:
+        print("network_monitor:", e, file=sys.stderr)
+        return 2
 
     bootstrap = None
     if args.bootstrap and not args.local:
@@ -137,8 +181,16 @@ def main(argv=None) -> int:
             except TimeoutError as e:
                 print("Test timeout !", e, file=sys.stderr)
                 return 1
+            pcts = mon.percentiles(tuple(sorted({50, 95, *alerts})))
             print(datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
-                  "Test completed successfully in", round(dt, 3))
+                  "Test completed successfully in", round(dt, 3),
+                  "| round-trip " + " ".join(
+                      "p%g=%.3fs" % (p, v) for p, v in sorted(pcts.items())))
+            for pct, thr in sorted(alerts.items()):
+                if pcts[pct] > thr:
+                    print("ALERT: round-trip p%g %.3fs exceeds %.3fs"
+                          % (pct, pcts[pct], thr), file=sys.stderr)
+                    return 1
             done_rounds += 1
             if args.rounds and done_rounds >= args.rounds:
                 break

@@ -20,6 +20,8 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
     q? <hash> <where>      query (e.g. q? <hash> id=42)
     il <name> <key> [vid]  index: insert (key as field=value)
     ii <name> <key>        index: lookup
+    stats [prom]           unified telemetry (JSON snapshot; 'prom' =
+                           Prometheus text, same registry as GET /stats)
     stt <port>             start REST proxy server
     stp                    stop REST proxy server
     pst <host:port>        switch backend to a REST proxy (client)
@@ -96,6 +98,16 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
             elif op == "info":
                 print_node_info(node)
                 print_node_stats(node)
+            elif op == "stats":
+                # the unified telemetry registry (ISSUE-3): same data
+                # the proxy serves on GET /stats
+                if rest and rest[0] in ("prom", "prometheus"):
+                    from ..telemetry import get_registry
+                    print(get_registry().prometheus(), end="")
+                else:
+                    import json as _json
+                    print(_json.dumps(node.get_metrics(), indent=2,
+                                      sort_keys=True))
             elif op == "ll":
                 d = node._dht
                 for af in (socket.AF_INET,):

@@ -31,6 +31,22 @@ def test_network_monitor_cli():
                          "-t", "25", "-p", "0.1"]) == 0
 
 
+def test_network_monitor_percentile_alerting(capsys):
+    """ISSUE-3 satellite: per-percentile alert thresholds drive the exit
+    code, and the round report quotes p50/p95 from the round-trip
+    histogram (not just the last round's wall time)."""
+    # an impossible p50 threshold must trip the alert exit code
+    assert monitor_main(["--local", "-n", "2", "--rounds", "2",
+                         "-t", "25", "-p", "0.1",
+                         "--alert", "p50=0.000001"]) == 1
+    out = capsys.readouterr()
+    assert "round-trip p50=" in out.out and "p95=" in out.out
+    assert "ALERT: round-trip p50" in out.err
+    # malformed specs are a usage error (exit 2), not a crash
+    assert monitor_main(["--local", "--alert", "p50"]) == 2
+    assert monitor_main(["--local", "--alert", "p200=1"]) == 2
+
+
 def test_dhtcluster_resize_and_stats():
     net = NodeCluster()
     try:

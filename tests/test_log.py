@@ -60,6 +60,63 @@ def test_per_hash_filter():
     assert cap.lines == ["about one", "untagged 2"]
 
 
+def test_filter_applies_to_core_runtime_records():
+    """ISSUE-3 satellite: ``set_filter`` must govern records emitted by
+    the core runtime loggers (children of "opendht_tpu"), exactly as the
+    docstring promises — tagged records for the filtered key pass,
+    records tagged with another key AND untagged records are suppressed,
+    and clearing the filter restores everything."""
+    lg, cap = _capturing_logger("opendht_tpu.t_core")
+    core = logging.getLogger("opendht_tpu.t_core.dht")   # child module
+    h1, h2 = InfoHash.get("one"), InfoHash.get("two")
+
+    lg.set_filter(h1)
+    core.warning("[search %s] expired", "one",
+                 extra={"dht_hash": bytes(h1)})          # tagged, match
+    core.warning("[search %s] expired", "two",
+                 extra={"dht_hash": bytes(h2)})          # tagged, other
+    core.warning("untagged core record")                 # untagged
+    assert cap.lines == ["[search one] expired"]
+
+    lg.set_filter(None)
+    core.warning("untagged core record 2")
+    assert cap.lines[-1] == "untagged core record 2"
+
+
+def test_tagged_call_sites_carry_dht_hash():
+    """The audited runtime call sites must actually tag their records:
+    drive one (_on_error's token flush) through a real Dht and assert
+    the record filters by the node id."""
+    from opendht_tpu.net.engine import DhtProtocolException
+    from opendht_tpu.net.request import Request
+    from opendht_tpu.net.parsed_message import MessageType
+    from opendht_tpu.net.node import Node
+    from opendht_tpu.runtime.config import Config
+    from opendht_tpu.runtime.dht import Dht
+    from opendht_tpu.sockaddr import SockAddr
+
+    lg, cap = _capturing_logger("opendht_tpu")
+    try:
+        dht = Dht(lambda d, a: 0, Config(node_id=InfoHash.get("self")),
+                  has_v4=True, has_v6=False)
+        node_id = InfoHash.get("flushed-peer")
+        node = Node(node_id, SockAddr("10.0.0.7", 4007))
+        req = Request(MessageType.ANNOUNCE_VALUE, 1, node, b"", None, None)
+
+        lg.set_filter(InfoHash.get("some-other-key"))
+        dht._on_error(req, DhtProtocolException(
+            DhtProtocolException.UNAUTHORIZED))
+        assert cap.lines == []                  # suppressed: other key
+
+        lg.set_filter(node_id)
+        req2 = Request(MessageType.ANNOUNCE_VALUE, 2, node, b"", None, None)
+        dht._on_error(req2, DhtProtocolException(
+            DhtProtocolException.UNAUTHORIZED))
+        assert any("token flush" in ln for ln in cap.lines)
+    finally:
+        lg.disable()
+
+
 def test_file_sink(tmp_path):
     lg = DhtLogger("t.file")
     path = str(tmp_path / "dht.log")
