@@ -16,7 +16,12 @@ variance.  Two artifact kinds:
   15% covers tunneled-device run-to-run wander while still catching
   stale quotes).  Extra structured fields are checked where quoted:
   ``p50 X ms`` vs ``wave_ms_p50`` (±30%) and ``XK mutations/s`` vs
-  ``mutations_per_s`` (±15%).
+  ``mutations_per_s`` (±15%).  Captures with ``unit: "percent"`` (the
+  telemetry/tracing overhead artifacts) check ``measures X%`` quotes
+  against ``value`` and ``X% with sampling off`` against
+  ``sampling_off_pct``, within max(1 percentage point, 50% relative)
+  — overhead numbers are noise-level, so the band is absolute-floored
+  while still catching the 2x-class drift this checker exists for.
 
 For every capture artifact that exists, at least one tagged line must
 exist in README.md — a quote cannot silently disappear.  Usage:
@@ -153,6 +158,22 @@ def check_config_captures(failures):
                                 f"{doc}: [{tag}] quotes {q}K mutations/s "
                                 f"vs captured {cap['mutations_per_s']:.0f} "
                                 f"(±15%)")
+                if cap.get("unit") == "percent":
+                    def _pct_band(quoted, captured, what):
+                        tol = max(1.0, 0.5 * abs(captured))
+                        if abs(quoted - captured) > tol:
+                            failures.append(
+                                f"{doc}: [{tag}] quotes {what} "
+                                f"{quoted}% vs captured {captured} "
+                                f"(±{tol:.1f}pp)")
+                    for q in re.findall(r"measures (\d+(?:\.\d+)?)%", ln):
+                        _pct_band(float(q), cap["value"], "overhead")
+                    if "sampling_off_pct" in cap:
+                        for q in re.findall(
+                                r"(-?\d+(?:\.\d+)?)% with sampling off",
+                                ln):
+                            _pct_band(float(q), cap["sampling_off_pct"],
+                                      "sampling-off overhead")
         if not any_tagged and os.path.exists(readme) \
                 and cname not in _OPTIONAL:
             failures.append(f"README.md: no '{tag}'-tagged quote "

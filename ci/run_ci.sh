@@ -96,6 +96,35 @@ from opendht_tpu.testing.telemetry_smoke import main
 rc = main()
 assert rc == 0, "telemetry smoke failed"
 PY
+# tracing smoke (round 9): boot a 5-node real-UDP cluster, run one
+# traced put+get, assemble the cross-node span tree (>=3 nodes
+# contributed spans, correct parentage, monotone timestamps), check
+# the Chrome/Perfetto dump round-trips with the exact ph/pid/tid/ts/
+# dur fields, the flight-recorder dump parses, and the ring's
+# bounded-memory property (10x capacity pushed -> oldest evicted,
+# RSS-stable).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.trace_assembler import main
+rc = main()
+assert rc == 0, "tracing smoke failed"
+PY
+# tracing overhead smoke (round 9): the sampled-on 8192-wave round must
+# stay inside a generous 10% band vs the tracer-disabled run (the
+# committed captures/trace_overhead.json documents the tight number,
+# enforced against the README quote by check_docs above).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib
+spec = importlib.util.spec_from_file_location(
+    "exp_trace_r9", pathlib.Path("benchmarks/exp_trace_r9.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "tracing overhead smoke failed"
+PY
 # table-sharded iterative mode on a REAL 8-device virtual mesh.  The
 # heredoc (rather than env vars + the module CLI) is deliberate: on
 # hosts that register an accelerator backend via sitecustomize, the

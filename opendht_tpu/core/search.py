@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 
 import numpy as np
 import jax
@@ -690,6 +691,9 @@ def _is_tracer(x) -> bool:
         return False                # instrumentation, never a crash)
 
 
+_TRACE_MAX_ROUND_SPANS = 64
+
+
 def record_wave(out, elapsed_s: float, wave_width: int, *,
                 mode: str = "single") -> None:
     """Feed one completed search wave into the telemetry spine
@@ -699,8 +703,24 @@ def record_wave(out, elapsed_s: float, wave_width: int, *,
     lockstep inside the compiled while_loop, so the per-round figure is
     the wave quotient, not a per-round host probe), and the wave-width /
     hops distributions.  Shared by the single-device engine and the
-    tp-sharded twin (``mode="tp"``, parallel/sharded.py)."""
-    from .. import telemetry
+    tp-sharded twin (``mode="tp"``, parallel/sharded.py).
+
+    ISSUE-4: when an ambient trace context is active the same envelope
+    records the wave into the distributed tracer — one
+    ``dht.search.wave`` child span plus one ``dht.search.round`` child
+    per round.  Context-gated ON PURPOSE: an untraced bench loop would
+    otherwise mint ~rounds+1 root spans per wave into the shared ring
+    and evict the flight-recorder events it exists to retain (found by
+    review) — to trace a wave, activate a root first (``with
+    tracing.activate(TraceContext.new_root()): simulate_lookups(...)``,
+    the exact recipe PARITY gives for settling the OPEN p95-wave bound
+    on chip).  Round spans carry the wave-quotient duration — the
+    rounds run in lockstep inside the compiled while_loop, so the even
+    split IS the attribution the telemetry histogram reports.
+    Host-side only: the traced computation ran BEFORE this call —
+    tracing cannot perturb the kernels (pinned in
+    tests/test_tracing.py)."""
+    from .. import telemetry, tracing
     reg = telemetry.get_registry()
     reg.histogram("dht_search_wave_seconds", mode=mode).observe(elapsed_s)
     reg.histogram("dht_search_wave_width", mode=mode).observe(wave_width)
@@ -710,6 +730,19 @@ def record_wave(out, elapsed_s: float, wave_width: int, *,
     if rounds > 0:
         reg.histogram("dht_search_round_seconds", mode=mode).observe(
             elapsed_s / rounds)
+    tr = tracing.get_tracer()
+    ctx = tracing.current()
+    if tr.enabled and ctx is not None:
+        end = time.time()
+        start = end - elapsed_s
+        wave_ctx = tr.record("dht.search.wave", start, elapsed_s,
+                             parent=ctx, mode=mode,
+                             width=int(wave_width), rounds=rounds)
+        if wave_ctx is not None and 0 < rounds <= _TRACE_MAX_ROUND_SPANS:
+            per_round = elapsed_s / rounds
+            for i in range(rounds):
+                tr.record("dht.search.round", start + i * per_round,
+                          per_round, parent=wave_ctx, mode=mode, round=i)
 
 
 def simulate_lookups(sorted_ids, n_valid, targets, **kw):

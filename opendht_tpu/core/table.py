@@ -412,6 +412,14 @@ class NodeTable:
         self._pending_base = None
         self.compactions += 1
         _M_COMPACTIONS.inc()
+        # flight recorder (ISSUE-4): churn swaps / compactions are
+        # postmortem-grade events — when a lookup traces slow, the ring
+        # shows whether a base swap landed mid-wave
+        from .. import tracing
+        _tr = tracing.get_tracer()
+        if _tr.enabled:
+            _tr.event("table_churn_swap", replayed=len(pb["mutlog"]),
+                      compactions=self.compactions)
         for op, row in pb["mutlog"]:
             if op == "i":
                 if not self._churn.note_insert(row, self._ids[row]):

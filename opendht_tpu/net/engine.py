@@ -19,7 +19,7 @@ import socket as _socket
 from dataclasses import dataclass, field as _field
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..infohash import InfoHash
 from ..rate_limiter import RateLimiter
 from ..scheduler import Scheduler
@@ -197,6 +197,14 @@ class NetworkEngine:
         self._m_ratelimit_drops = reg.counter("dht_net_ratelimit_drops_total")
         self._m_sent: Dict[object, telemetry.Counter] = {}
         self._m_timeouts = reg.counter("dht_net_request_timeouts_total")
+        # distributed tracing (ISSUE-4): client spans on outgoing
+        # queries (the wire context is the span's own ctx), server
+        # spans around incoming request dispatch, flight-recorder
+        # events on drops/timeouts.  One tracer per process; spans are
+        # tagged with this engine's node id so multi-node test
+        # processes still assemble per-node trees.
+        self._tracer = tracing.get_tracer()
+        self._node_tag = str(myid)
 
     def _count_msg(self, direction: str, mtype: str) -> None:
         c = self._m_msgs.get((direction, mtype))
@@ -212,9 +220,13 @@ class NetworkEngine:
 
     # ------------------------------------------------------------------ util
     def _header(self, body_key: str, body: dict, y: str, tid: int,
-                query: Optional[str] = None) -> bytes:
+                query: Optional[str] = None,
+                trace: "tracing.TraceContext | None" = None) -> bytes:
         """Assemble the outer packet map in the reference's key order:
-        a/r/e, [q], t, y, v, [n] (network_engine.cpp:677-1305)."""
+        a/r/e, [q], t, y, v, [n], [tr] (network_engine.cpp:677-1305;
+        ``tr`` is this port's optional trace-context key — appended
+        LAST so every byte before it is unchanged when absent, and old
+        parsers skip it as an unknown top-level key)."""
         out: dict = {body_key: body}
         if query is not None:
             out["q"] = query
@@ -228,7 +240,23 @@ class NetworkEngine:
         out["v"] = AGENT
         if self.network:
             out["n"] = self.network
+        if trace is not None:
+            out[tracing.TRACE_WIRE_KEY] = trace.to_wire()
         return pack_msg(out)
+
+    def _trace_client(self, mtype: str, node: Node):
+        """Open the per-RPC client span when an ambient sampled trace
+        context is active (the runner op / search step activated it);
+        returns ``(span_or_None, wire_ctx_or_None)``.  The span's OWN
+        context is what rides the wire, so the receiving node's server
+        span parents to this hop."""
+        ctx = tracing.current()
+        if ctx is None or not ctx.sampled or not self._tracer.enabled:
+            return None, None
+        span = self._tracer.span("dht.rpc." + mtype, parent=ctx,
+                                 kind="client", node=self._node_tag,
+                                 peer=str(node.addr))
+        return span, span.ctx
 
     def _send(self, data: bytes, addr: SockAddr) -> int:
         try:
@@ -308,6 +336,11 @@ class NetworkEngine:
                     # out (counting here, not at step entry, so EAGAIN
                     # reschedules of the SAME attempt count once)
                     self._m_timeouts.inc()
+                    if self._tracer.enabled:
+                        self._tracer.event(
+                            "request_timeout", node=self._node_tag,
+                            type=req.type.value, tid=req.tid,
+                            attempt=req.attempt_count)
                 req.attempt_count += 1
             req.last_try = now
             self.scheduler.add(req.last_try + MAX_RESPONSE_TIME,
@@ -365,6 +398,10 @@ class NetworkEngine:
             return          # self-message
         if msg.type in REQUEST_TYPES and not self._rate_limit(from_addr):
             self._m_ratelimit_drops.inc()
+            if self._tracer.enabled:
+                self._tracer.event("ratelimit_drop", node=self._node_tag,
+                                   type=msg.type.value,
+                                   addr=str(from_addr))
             return
 
         if not msg.value_parts:
@@ -391,8 +428,26 @@ class NetworkEngine:
         now = self.scheduler.time()
         node = self.cache.get_node(msg.id, from_addr, now, confirm=True,
                                    client=msg.is_client)
+        # ISSUE-4: an incoming request carrying a sampled wire context
+        # records a server span around the whole handler + reply send,
+        # parented to the sender's per-hop client span — that link is
+        # what the cross-node assembler stitches trees from.
+        tctx = msg.trace_ctx
+        span = (self._tracer.span("dht.server." + msg.type.value,
+                                  parent=tctx, kind="server",
+                                  node=self._node_tag,
+                                  peer=str(from_addr))
+                if (tctx is not None and tctx.sampled
+                    and msg.type in REQUEST_TYPES
+                    and self._tracer.enabled)
+                else tracing.NOOP_SPAN)
         try:
-            self._dispatch(msg, node, from_addr, now)
+            with span:
+                try:
+                    self._dispatch(msg, node, from_addr, now)
+                except DhtProtocolException as e:
+                    span.set(error=e.code)      # before the span ends
+                    raise
         except DhtProtocolException as e:
             if msg.type in REQUEST_TYPES:
                 self.send_error(from_addr, msg.tid, e.code, e.msg,
@@ -574,11 +629,13 @@ class NetworkEngine:
     # ------------------------------------------------------------ tx: queries
     def send_ping(self, node: Node, on_done=None, on_expired=None) -> Request:
         tid = node.get_new_tid()
-        data = self._header("a", {"id": bytes(self.myid)}, "q", tid, query="ping")
+        span, tctx = self._trace_client("ping", node)
+        data = self._header("a", {"id": bytes(self.myid)}, "q", tid,
+                            query="ping", trace=tctx)
         req = Request(MessageType.PING, tid, node, data,
                       (lambda r, m: on_done(r, RequestAnswer.from_msg(m)))
                       if on_done else None,
-                      on_expired)
+                      on_expired, trace_span=span)
         self._send_request(req)
         self.out_stats.ping += 1
         self._count_msg("out", "ping")
@@ -590,11 +647,12 @@ class NetworkEngine:
         body: dict = {"id": bytes(self.myid), "target": bytes(target)}
         if want > 0:
             body["w"] = self._want_list(want)
-        data = self._header("a", body, "q", tid, query="find")
+        span, tctx = self._trace_client("find", node)
+        data = self._header("a", body, "q", tid, query="find", trace=tctx)
         req = Request(MessageType.FIND_NODE, tid, node, data,
                       (lambda r, m: on_done(r, RequestAnswer.from_msg(m)))
                       if on_done else None,
-                      on_expired)
+                      on_expired, trace_span=span)
         self._send_request(req)
         self.out_stats.find += 1
         self._count_msg("out", "find")
@@ -608,11 +666,12 @@ class NetworkEngine:
             body["q"] = query.wire_obj()
         if want > 0:
             body["w"] = self._want_list(want)
-        data = self._header("a", body, "q", tid, query="get")
+        span, tctx = self._trace_client("get", node)
+        data = self._header("a", body, "q", tid, query="get", trace=tctx)
         req = Request(MessageType.GET_VALUES, tid, node, data,
                       (lambda r, m: on_done(r, RequestAnswer.from_msg(m)))
                       if on_done else None,
-                      on_expired)
+                      on_expired, trace_span=span)
         self._send_request(req)
         self.out_stats.get += 1
         self._count_msg("out", "get")
@@ -635,11 +694,12 @@ class NetworkEngine:
                       "token": token, "sid": pack_tid(sid)}
         if not query.where.empty() or not query.select.empty():
             body["q"] = query.wire_obj()
-        data = self._header("a", body, "q", tid, query="listen")
+        span, tctx = self._trace_client("listen", node)
+        data = self._header("a", body, "q", tid, query="listen", trace=tctx)
         req = Request(MessageType.LISTEN, tid, node, data,
                       (lambda r, m: on_done(r, RequestAnswer.from_msg(m)))
                       if on_done else None,
-                      on_expired, socket_id=sid)
+                      on_expired, socket_id=sid, trace_span=span)
         self._send_request(req)
         self.out_stats.listen += 1
         self._count_msg("out", "listen")
@@ -655,14 +715,16 @@ class NetworkEngine:
         if created is not None and created < wall_now():
             body["c"] = int(created)
         body["token"] = token
-        data = self._header("a", body, "q", tid, query="put")
+        span, tctx = self._trace_client("put", node)
+        data = self._header("a", body, "q", tid, query="put", trace=tctx)
 
         def done(r, m: ParsedMessage):
             if m.value_id != Value.INVALID_ID and on_done:
                 on_done(r, RequestAnswer(vid=m.value_id))
 
         req = Request(MessageType.ANNOUNCE_VALUE, tid, node, data,
-                      done if on_done else None, on_expired)
+                      done if on_done else None, on_expired,
+                      trace_span=span)
         self._send_request(req)
         if parts:
             self._send_value_parts(tid, parts, node.addr)
@@ -675,14 +737,16 @@ class NetworkEngine:
         tid = node.get_new_tid()
         body = {"id": bytes(self.myid), "h": bytes(info_hash), "vid": vid,
                 "token": token}
-        data = self._header("a", body, "q", tid, query="refresh")
+        span, tctx = self._trace_client("refresh", node)
+        data = self._header("a", body, "q", tid, query="refresh", trace=tctx)
 
         def done(r, m: ParsedMessage):
             if m.value_id != Value.INVALID_ID and on_done:
                 on_done(r, RequestAnswer(vid=m.value_id))
 
         req = Request(MessageType.REFRESH, tid, node, data,
-                      done if on_done else None, on_expired)
+                      done if on_done else None, on_expired,
+                      trace_span=span)
         self._send_request(req)
         self.out_stats.refresh += 1
         self._count_msg("out", "refresh")

@@ -5,8 +5,11 @@ One msgpack map per UDP packet.  Top-level keys:
 ``t`` transaction id (4B bin or int), ``v`` agent string, ``n`` network
 id, ``q`` query verb ∈ {ping, find, get, listen, put, refresh}, ``a``
 (query args) / ``r`` (reply body) / ``e`` [code, msg] / ``u`` (value
-update body).  Body keys: id, h, target, sid, token, vid, values,
-fields, exp, re, n4, n6, sa, c, w, q(uery).
+update body), and the OPTIONAL ``tr`` distributed-trace context
+(ISSUE-4; strictly bounded decode, ignored by parsers that predate it
+— unknown top-level keys are skipped by construction).  Body keys:
+id, h, target, sid, token, vid, values, fields, exp, re, n4, n6, sa,
+c, w, q(uery).
 
 Fragmentation: a value too large for one packet is announced as an
 integer size in the ``values`` array, then streamed as ``y:"v"``
@@ -21,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
+from ..tracing import TRACE_WIRE_KEY, decode_wire
 from ..utils import unpack_msg
 from ..core.value import MAX_VALUE_SIZE, Field, FieldValueIndex, Query, Value
 
@@ -76,7 +80,7 @@ class ParsedMessage:
         "socket_id", "token", "value_id", "created", "nodes4_raw",
         "nodes6_raw", "nodes4", "nodes6", "values", "refreshed_values",
         "expired_values", "fields", "value_parts", "query", "want",
-        "error_code", "ua", "addr",
+        "error_code", "ua", "addr", "trace_ctx",
     )
 
     def __init__(self):
@@ -106,6 +110,7 @@ class ParsedMessage:
         self.error_code = 0
         self.ua = ""
         self.addr = SockAddr()
+        self.trace_ctx = None           # ISSUE-4: optional wire context
 
     # -- decoding ----------------------------------------------------------
     @classmethod
@@ -131,6 +136,12 @@ class ParsedMessage:
             self.network = int(msg["n"])
         if "s" in msg:
             self.is_client = bool(msg["s"])
+        if TRACE_WIRE_KEY in msg:
+            # bounded decode: any malformed / hostile oversized blob is
+            # ignored (None), never raised, never echoed — and every
+            # OTHER unknown top-level key is skipped by construction
+            # (tests/test_wire_fuzz.py proves both directions)
+            self.trace_ctx = decode_wire(msg[TRACE_WIRE_KEY])
         q = msg.get("q")
 
         # type inference (parsed_message.h:153-176)

@@ -12,14 +12,22 @@ stamped by the engine on scheduler time), expiry into
 ``dht_net_requests_expired_total{type=}``, cancellation into
 ``dht_net_requests_cancelled_total{type=}``.  The matching send-side
 counters (sent / per-attempt timeouts) live in
-:mod:`~opendht_tpu.net.engine`."""
+:mod:`~opendht_tpu.net.engine`.
+
+Distributed tracing (ISSUE-4): a request sent under a sampled trace
+context carries the engine-opened per-hop client span in
+``trace_span``; the terminal transition stamps the outcome and closes
+it, so the span's duration is the full send→reply (or →expiry) life of
+the RPC including retries.  Expiry/cancellation additionally drop a
+flight-recorder event (the exceptional state transitions; completions
+are already the span)."""
 
 from __future__ import annotations
 
 import enum
 from typing import TYPE_CHECKING, Callable, Dict, Optional
 
-from .. import telemetry
+from .. import telemetry, tracing
 from .node import MAX_RESPONSE_TIME, Node
 
 if TYPE_CHECKING:
@@ -59,13 +67,13 @@ class RequestState(enum.Enum):
 class Request:
     __slots__ = ("node", "tid", "type", "msg", "on_done", "on_expired",
                  "socket_id", "state", "attempt_count", "start", "last_try",
-                 "reply_time")
+                 "reply_time", "trace_span")
 
     def __init__(self, msg_type: "MessageType", tid: int, node: Node,
                  msg: bytes,
                  on_done: Optional[Callable[["Request", "ParsedMessage"], None]],
                  on_expired: Optional[Callable[["Request", bool], None]],
-                 socket_id: int = 0):
+                 socket_id: int = 0, trace_span=None):
         self.node = node
         self.tid = tid
         self.type = msg_type
@@ -78,6 +86,7 @@ class Request:
         self.start = _NEVER
         self.last_try = _NEVER
         self.reply_time = _NEVER
+        self.trace_span = trace_span      # per-hop client span (ISSUE-4)
 
     # -- state predicates --------------------------------------------------
     @property
@@ -110,11 +119,24 @@ class Request:
                 and self.attempt_count >= MAX_ATTEMPT_COUNT)
 
     # -- transitions (request.h:88-105) ------------------------------------
+    def _finish_span(self, outcome: str) -> None:
+        sp = self.trace_span
+        if sp is not None:
+            sp.set(outcome=outcome, attempts=self.attempt_count,
+                   tid=self.tid)
+            sp.end()
+            self.trace_span = None
+
     def set_expired(self) -> None:
         if self.pending:
             self.state = RequestState.EXPIRED
             _metric("counter", "dht_net_requests_expired_total",
                     self.type).inc()
+            tr = tracing.get_tracer()
+            if tr.enabled:
+                tr.event("request_expired", type=self.type.value,
+                         tid=self.tid, attempts=self.attempt_count)
+            self._finish_span("expired")
             if self.on_expired:
                 self.on_expired(self, True)
             self._clear()
@@ -127,6 +149,7 @@ class Request:
             if self.reply_time != _NEVER and self.start != _NEVER:
                 _metric("histogram", "dht_net_rtt_seconds", self.type) \
                     .observe(max(self.reply_time - self.start, 0.0))
+            self._finish_span("completed")
             if self.on_done:
                 self.on_done(self, msg)
             self._clear()
@@ -136,6 +159,11 @@ class Request:
             self.state = RequestState.CANCELLED
             _metric("counter", "dht_net_requests_cancelled_total",
                     self.type).inc()
+            tr = tracing.get_tracer()
+            if tr.enabled:
+                tr.event("request_cancelled", type=self.type.value,
+                         tid=self.tid)
+            self._finish_span("cancelled")
             self._clear()
 
     def close_socket(self) -> int:

@@ -450,7 +450,10 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
                   jnp.asarray(seed, jnp.int32))
     # same host-side envelope as the single-device entry (core/search.py
     # simulate_lookups): the traced computation is untouched, the span
-    # blocks and the wave/hops series land under mode="tp"
+    # blocks and the wave/hops series land under mode="tp" — and via
+    # record_wave the distributed tracer gets the mode="tp" wave/round
+    # spans too (ISSUE-4), so a sharded lookup shows up in the same
+    # Chrome/Perfetto timeline as the single-device one
     with reg.span("dht_search_wave_seconds", record=False) as sp:
         out = fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
                  jnp.asarray(seed, jnp.int32))

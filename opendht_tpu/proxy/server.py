@@ -6,6 +6,9 @@ src/dht_proxy_server.cpp:70-93 routes, include/opendht/dht_proxy_server.h):
 routes
     ``GET /``                  node info (node id + per-family stats)
     ``STATS /``                server stats (listen/put counts, request rate)
+    ``GET /trace``             flight-recorder dump (ISSUE-4)
+    ``GET /trace/{id}``        one distributed trace's spans
+                               (``?fmt=chrome`` = Perfetto-loadable dump)
     ``GET /{hash}``            stream values as JSON lines
     ``GET /{hash}/{value_id}`` one value by id
     ``LISTEN /{hash}``         long-poll stream of value updates
@@ -37,7 +40,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..infohash import InfoHash
 from ..core.value import Value
 from .json_codec import value_to_json, value_from_json, permanent_deadline
@@ -359,6 +362,23 @@ def _make_handler(server: DhtProxyServer):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                return
+            if parts[0] == "trace":
+                # GET /trace → the node's flight-recorder dump (ISSUE-4;
+                # the reference's dumpTables as a scrapeable surface);
+                # GET /trace/<id> → one trace's span list, or the
+                # Perfetto-loadable Chrome dump with ?fmt=chrome.
+                # "trace" is not a valid hash, so — like /stats — the
+                # path was previously a 400 and stays unambiguous.
+                tr = tracing.get_tracer()
+                if len(parts) == 1:
+                    self._send_json(tr.dump())
+                elif _q.get("fmt", [""])[0] == "chrome":
+                    self._send_json(tracing.to_chrome_trace(
+                        tr.spans(parts[1])))
+                else:
+                    self._send_json({"trace_id": parts[1],
+                                     "spans": tr.spans(parts[1])})
                 return
             key = self._hash_arg(parts)
             if key is None:

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import tracing
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
 from ..scheduler import Scheduler
@@ -64,6 +65,22 @@ MAX_STORAGE_MAINTENANCE_EXPIRE_TIME = 10 * 60.0    # (dht.h:335)
 
 #: the query standing for a token-only sync probe ('find_node' path)
 _ANY_QUERY = Query(none=True)
+
+
+def _traced_search(fn):
+    """Re-activate the search's trace context around an RPC-sending
+    method (ISSUE-4).  Search steps fire from scheduler jobs and reply
+    callbacks, where the originating op's ambient context is long gone
+    — the Search object carries it (live_search.Search.trace_ctx) and
+    this wrapper restores it (including to None: a step of an untraced
+    search must not inherit a foreign op's context), so the engine's
+    ``send_*`` sites see the right parent for every hop."""
+    def wrapper(self, sr, *args, **kw):
+        with tracing.activate(sr.trace_ctx):
+            return fn(self, sr, *args, **kw)
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
 
 
 def _quota_key(addr: SockAddr) -> tuple:
@@ -390,6 +407,14 @@ class Dht:
             srs[target] = sr
             insort(keys, bytes(target))
 
+        # adopt the calling op's trace context (runner ops activate it
+        # around the posted closure) UNCONDITIONALLY: a reused search
+        # re-parents its remaining hops under the newest op — and an
+        # untraced op clears a finished trace's context, so its RPCs
+        # never leak spans (or wire bytes) into a trace that already
+        # ended (found by review)
+        sr.trace_ctx = tracing.current()
+
         if get_cb or query_cb:
             sr.callbacks.append(Get(
                 start=self.scheduler.time(), filter=f,
@@ -481,6 +506,7 @@ class Dht:
             if pending is None or nxt < pending:
                 self._edit_step(sr, nxt)
 
+    @_traced_search
     def _search_send_get_values(self, sr: Search,
                                 pn: Optional[SearchNode] = None,
                                 update: bool = True) -> Optional[SearchNode]:
@@ -559,6 +585,7 @@ class Dht:
                     sync_time, lambda: self._search_step(sr))
         self._on_get_values_done(req.node, answer, sr, query)
 
+    @_traced_search
     def _paginate(self, sr: Search, query: Query, n: SearchNode) -> None:
         """SELECT id probe, then per-id sub-gets — keeps every reply under
         the value-size packet cap (↔ Dht::paginate, src/dht.cpp:258-310)."""
@@ -584,8 +611,12 @@ class Dht:
                 self._search_node_get_done(req, answer, sr, query)
 
         n.pagination_queries.setdefault(query, []).append(select_q)
+        # the per-id sub-gets are sent from the select reply callback —
+        # restore the search's context around it (ISSUE-4)
         n.get_status[select_q] = self.engine.send_get_values(
-            n.node, sr.id, select_q, -1, on_select_done,
+            n.node, sr.id, select_q, -1,
+            lambda r, a: tracing.run_with(sr.trace_ctx,
+                                          lambda: on_select_done(r, a)),
             self._mk_get_expired(sr, select_q))
 
     def _on_get_values_done(self, node: Node, a: RequestAnswer, sr: Search,
@@ -624,6 +655,7 @@ class Dht:
             self._edit_step(sr, self.scheduler.time())
 
     # ----------------------------------------------------------- announce path
+    @_traced_search
     def _search_send_announce(self, sr: Search) -> None:
         """Probe synced nodes with SELECT id,seq then put/refresh
         (↔ Dht::searchSendAnnounceValue, src/dht.cpp:380-485)."""
@@ -700,8 +732,13 @@ class Dht:
                         self._edit_step(sr, now)
 
             sn.probe_query = probe_query
+            # the select-done callback fires from the reply path (no
+            # ambient context) and sends the put/refresh itself —
+            # restore the search's context around it (ISSUE-4)
             sn.get_status[probe_query] = self.engine.send_get_values(
-                sn.node, sr.id, probe_query, -1, on_select_done,
+                sn.node, sr.id, probe_query, -1,
+                lambda r, a, _cb=on_select_done: tracing.run_with(
+                    sr.trace_ctx, lambda: _cb(r, a)),
                 self._mk_get_expired(sr, probe_query))
             if not sn.candidate:
                 i += 1
@@ -715,6 +752,7 @@ class Dht:
         sr.check_announced(answer.vid)
 
     # ------------------------------------------------------------- listen path
+    @_traced_search
     def _search_node_listen(self, sr: Search, sn: SearchNode) -> None:
         """Maintain listen contracts on one synced node
         (↔ Dht::searchSynchedNodeListen, src/dht.cpp:487-557)."""

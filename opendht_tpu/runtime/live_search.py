@@ -284,6 +284,12 @@ class Search:
         self.refill_time = _NEVER
         self.step_time = _NEVER
         self.next_search_step: Optional[Job] = None
+        # ISSUE-4: the trace context of the op that (re)started this
+        # search — scheduler-driven steps re-activate it so every hop's
+        # RPC parents under the originating get/put/listen span (a
+        # reused search adopts the newest op's context, traced or not:
+        # an untraced op must clear a predecessor's finished trace)
+        self.trace_ctx = None
         self.expired = False
         self.done = False
         self.nodes: List[SearchNode] = []

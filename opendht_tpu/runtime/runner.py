@@ -32,7 +32,7 @@ import threading
 import time as _time
 from typing import Callable, List, Optional, Tuple
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
 from ..utils import TIME_MAX, lazy_module
@@ -52,6 +52,38 @@ RX_QUEUE_MAX_SIZE = 1024 * 16          # dhtrunner.cpp:45
 RX_QUEUE_MAX_DELAY = 0.5               # dhtrunner.cpp:414-418
 BOOTSTRAP_PERIOD = 10.0                # dhtrunner.h:409
 MAX_PACKET = 1500
+
+
+def _op_trace(op: str, key, done_cb, node_id=""):
+    """Mint the root client span for a public op (ISSUE-4): the span
+    covers enqueue → done callback — the per-request causality anchor
+    the whole wire-propagated trace hangs from.  Parentless ops consult
+    the head sampler (always-on by default, rate-limited via
+    ``Tracer.set_sample_rate`` / ``OPENDHT_TPU_TRACE_RATE`` in
+    production); an op called under an already-active ambient context
+    (e.g. a test or embedder grouping several ops into one trace)
+    becomes that trace's child instead of a new root.
+
+    Returns ``(trace_ctx_or_None, wrapped_done_cb)`` — the context is
+    activated around the posted closure so ``Dht._search`` adopts it."""
+    tr = tracing.get_tracer()
+    if not tr.enabled:
+        return None, done_cb
+    sp = tr.span("dht.op." + op, parent=tracing.current(), kind="client",
+                 node=node_id, op=op, key=str(key))
+    if not sp:
+        return None, done_cb
+    fired = []
+
+    def wrapped(ok, *args, **kw):
+        if not fired:
+            fired.append(True)
+            sp.set(ok=bool(ok))
+            sp.end()
+        if done_cb:
+            return done_cb(ok, *args, **kw)
+
+    return sp.ctx, wrapped
 
 
 def _op_metrics_cb(op: str, done_cb):
@@ -484,7 +516,10 @@ class DhtRunner:
             where=None) -> None:
         """(dhtrunner.cpp:610-620)"""
         done_cb = _op_metrics_cb("get", done_cb)
-        self._post(lambda dht: dht.get(key, get_cb, done_cb, f, where))
+        tctx, done_cb = _op_trace("get", key, done_cb,
+                                  str(self.get_node_id()))
+        self._post(lambda dht: tracing.run_with(
+            tctx, lambda: dht.get(key, get_cb, done_cb, f, where)))
 
     def get_sync(self, key: InfoHash, timeout: Optional[float] = 30.0,
                  f=None, where=None) -> List[Value]:
@@ -503,8 +538,11 @@ class DhtRunner:
             created: Optional[float] = None, permanent: bool = False) -> None:
         """(dhtrunner.cpp:727-750)"""
         done_cb = _op_metrics_cb("put", done_cb)
-        self._post(lambda dht: dht.put(key, value, done_cb, created,
-                                       permanent))
+        tctx, done_cb = _op_trace("put", key, done_cb,
+                                  str(self.get_node_id()))
+        self._post(lambda dht: tracing.run_with(
+            tctx, lambda: dht.put(key, value, done_cb, created,
+                                  permanent)))
 
     def put_sync(self, key: InfoHash, value: Value,
                  timeout: Optional[float] = 30.0,
@@ -518,13 +556,19 @@ class DhtRunner:
     def put_signed(self, key: InfoHash, value: Value, done_cb=None,
                    permanent: bool = False) -> None:
         done_cb = _op_metrics_cb("put_signed", done_cb)
-        self._post(lambda dht: dht.put_signed(key, value, done_cb, permanent))
+        tctx, done_cb = _op_trace("put_signed", key, done_cb,
+                                  str(self.get_node_id()))
+        self._post(lambda dht: tracing.run_with(
+            tctx, lambda: dht.put_signed(key, value, done_cb, permanent)))
 
     def put_encrypted(self, key: InfoHash, to: InfoHash, value: Value,
                       done_cb=None, permanent: bool = False) -> None:
         done_cb = _op_metrics_cb("put_encrypted", done_cb)
-        self._post(lambda dht: dht.put_encrypted(key, to, value, done_cb,
-                                                 permanent))
+        tctx, done_cb = _op_trace("put_encrypted", key, done_cb,
+                                  str(self.get_node_id()))
+        self._post(lambda dht: tracing.run_with(
+            tctx, lambda: dht.put_encrypted(key, to, value, done_cb,
+                                            permanent)))
 
     def cancel_put(self, key: InfoHash, vid: int) -> None:
         self._post(lambda dht: dht.cancel_put(key, vid))
@@ -563,9 +607,12 @@ class DhtRunner:
         # when the registry is disabled (_op_metrics_cb passes the base
         # through untouched in that case)
         listen_done = _op_metrics_cb("listen", lambda ok, *a, **kw: None)
+        tctx, listen_done = _op_trace("listen", key, listen_done,
+                                      str(self.get_node_id()))
 
         def op(dht):
-            backend_token = dht.listen(key, wrapped_cb, f, where)
+            backend_token = tracing.run_with(
+                tctx, lambda: dht.listen(key, wrapped_cb, f, where))
             with self._listeners_lock:
                 token = self._listener_token
                 self._listener_token += 1
@@ -718,6 +765,26 @@ class DhtRunner:
                 for field, v in st.to_dict().items():
                     reg.gauge("dht_routing_" + field, family=fam).set(v)
         return reg.snapshot()
+
+    def get_trace(self, trace_id) -> list:
+        """JSON-able span list of one distributed trace (ISSUE-4): the
+        op root span plus every per-hop client span this node sent and
+        every server span it recorded for that trace.  ``trace_id``
+        accepts an int, a 32-hex string, or a TraceContext.  The
+        cross-node assembler (testing/trace_assembler.py) calls this on
+        every cluster node and stitches the full tree."""
+        return tracing.get_tracer().spans(trace_id)
+
+    def get_flight_recorder(self, limit: "int | None" = None) -> dict:
+        """The bounded-ring flight recorder dump (↔ the reference's
+        ``Dht::dumpTables`` postmortem surface, structured): last-N
+        spans + events (request transitions, timeouts, rate-limit
+        drops, compactions, churn swaps)."""
+        d = tracing.get_tracer().dump()
+        if limit:
+            d["spans"] = d["spans"][-limit:]
+            d["events"] = d["events"][-limit:]
+        return d
 
     def get_node_message_stats(self, incoming: bool = False) -> list:
         """[ping, find, get, listen, put] counters

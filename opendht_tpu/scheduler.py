@@ -99,10 +99,19 @@ class Scheduler:
         tests pin this; see ISSUE-3 satellite)."""
         heap = self._heap
         if len(heap) > _COMPACT_MIN and 2 * self._stale > len(heap):
+            dropped = len(heap) - sum(1 for e in heap
+                                      if not e[2].cancelled)
             self._heap = [e for e in heap if not e[2].cancelled]
             heapq.heapify(self._heap)
             self._stale = 0
             self._m_compactions.inc()
+            # flight recorder (ISSUE-4): compactions are a first-class
+            # postmortem signal alongside the counter
+            from . import tracing
+            tr = tracing.get_tracer()
+            if tr.enabled:
+                tr.event("scheduler_compaction", dropped=dropped,
+                         kept=len(self._heap))
 
     # -- queue ops ---------------------------------------------------------
     def add(self, t: float, func: Callable[[], None]) -> Job:

@@ -43,7 +43,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span",
-    "get_registry",
+    "get_registry", "snapshot_diff",
 ]
 
 # histogram buckets are powers of two: bucket i covers
@@ -390,6 +390,38 @@ class MetricsRegistry:
                             m.buckets.clear()
                     else:
                         m.value = 0
+
+
+def snapshot_diff(before: dict, after: dict) -> dict:
+    """Per-series delta of two :meth:`MetricsRegistry.snapshot` dicts
+    (ISSUE-4 satellite: the overhead drivers and the tracing/telemetry
+    tests all need "what advanced between these two points" — this
+    replaces the hand-rolled registry subtraction).
+
+    Returns the same ``{"counters", "gauges", "histograms"}`` shape:
+    counters/gauges as value deltas (zero-delta series dropped),
+    histograms as ``{"count": Δcount, "sum": Δsum}`` for series whose
+    count moved.  Series present only in ``after`` diff against zero."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind in ("counters", "gauges"):
+        b = before.get(kind, {})
+        a = after.get(kind, {})
+        for key in sorted(set(a) | set(b)):
+            d = a.get(key, 0) - b.get(key, 0)
+            if d:
+                out[kind][key] = d
+    bh = before.get("histograms", {})
+    ah = after.get("histograms", {})
+    for key in sorted(set(ah) | set(bh)):
+        ad = ah.get(key, {})
+        bd = bh.get(key, {})
+        dc = ad.get("count", 0) - bd.get("count", 0)
+        if dc:
+            out["histograms"][key] = {
+                "count": dc,
+                "sum": ad.get("sum", 0.0) - bd.get("sum", 0.0),
+            }
+    return out
 
 
 _global_registry = MetricsRegistry()

@@ -11,10 +11,16 @@ import sys
 import time
 from typing import Optional, Tuple
 
-from .. import crypto
 from ..infohash import InfoHash
 from ..runtime.config import Config
 from ..runtime.runner import DhtRunner, RunnerConfig
+from ..utils import lazy_module
+
+# crypto is a CALL-time dependency only (identity generate/load/save):
+# lazy so the CLI tools import — and the identity-less REPL/scanner
+# paths run — without the `cryptography` wheel (same pattern as
+# runtime/runner.py, ISSUE-2 satellite)
+crypto = lazy_module("opendht_tpu.crypto")
 
 
 # canonical definition lives in the (crypto-free) package __init__ so

@@ -22,6 +22,13 @@ Checks (requester side of the reference wire format,
 8. get (big)   → fragmented values reassembled bit-exact
 9. put w/ forged token → protocol error 401 (UNAUTHORIZED)
 10. refresh unknown vid → protocol error 404 (NOT_FOUND)
+11. traced ping → a query carrying the ``tr`` trace-context key is
+    answered like any untraced one (ISSUE-4 wire compat; the first
+    10 checks send NO ``tr``, so they double as the pre-trace-peer →
+    tracing-peer direction)
+12. unknown-keys ping → a raw packet with hostile unknown top-level
+    keys (including an oversized fake trace blob) still gets a reply,
+    and the reply echoes none of the unknown bytes
 
 Every check is also a behavioral assertion from the conversation-golden
 tier (tests/test_wire_conversations.py) — this tool is those flows
@@ -37,12 +44,17 @@ import socket
 import sys
 import time
 
+from .. import tracing
 from ..core.value import Query, Value
 from ..infohash import InfoHash
 from ..net.engine import (DhtProtocolException, EngineCallbacks,
                           NetworkEngine)
+from ..net.parsed_message import ParsedMessage, pack_tid
 from ..scheduler import Scheduler
 from ..sockaddr import SockAddr
+from ..utils import pack_msg
+
+N_CHECKS = 12
 
 
 class LiveChecker:
@@ -197,6 +209,49 @@ def run_checks(host: str, port: int, network: int = 0,
         ok = c.pump(lambda: DhtProtocolException.NOT_FOUND in c.errors)
         step("refresh/unknown→404", ok, "" if ok else
              f"errors seen: {c.errors}")
+
+        # 11. traced ping: the optional tr key must not change behavior
+        box.clear()
+        root = tracing.TraceContext.new_root()
+        with tracing.activate(root):
+            c.engine.send_ping(c.node,
+                               on_done=lambda r, a: box.update(done=r))
+        ok = c.pump(lambda: "done" in box)
+        step("ping/trace-ctx", ok, "" if ok else "no reply")
+
+        # 12. unknown top-level keys (incl. an oversized hostile trace
+        # blob) parse cleanly on the peer and never echo back.  Blob
+        # sized to fit one UDP datagram under the node's 1500 B recv
+        # MTU — the multi-KB versions live in tests/test_wire_fuzz.py,
+        # which feeds the parser in-process without a datagram limit.
+        blob = b"\xaa" * 600
+        tid12 = 0x7A7A7A7A
+        raw = pack_msg({
+            "a": {"id": bytes(c.engine.myid)}, "q": "ping",
+            "t": pack_tid(tid12), "y": "q", "v": "RNG1",
+            "zz_future_key": blob, "tr": blob[:256],
+        })
+        c.sock.sendto(raw, (str(c.peer.ip), c.peer.port))
+        reply = None
+        deadline = time.monotonic() + c.timeout
+        while reply is None and time.monotonic() < deadline:
+            r, _, _ = select.select([c.sock], [], [], 0.05)
+            if not r:
+                continue
+            try:
+                data, _addr = c.sock.recvfrom(64 * 1024)
+            except OSError:
+                continue
+            try:
+                pm = ParsedMessage.from_bytes(data)
+            except Exception:
+                continue
+            if pm.tid == tid12:
+                reply = data
+        ok = reply is not None and blob[:64] not in reply
+        step("ping/unknown-keys", ok,
+             f"reply {len(reply)} B, no echo" if ok else
+             ("blob echoed!" if reply else "no reply"))
     finally:
         c.close()
     return results
@@ -233,7 +288,7 @@ def main(argv=None) -> int:
             runner.join()
     n_ok = sum(1 for _, ok, _ in results if ok)
     print(f"{n_ok}/{len(results)} checks passed")
-    return 0 if n_ok == len(results) == 10 else 1
+    return 0 if n_ok == len(results) == N_CHECKS else 1
 
 
 if __name__ == "__main__":

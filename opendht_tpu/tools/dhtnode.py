@@ -22,6 +22,13 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
     ii <name> <key>        index: lookup
     stats [prom]           unified telemetry (JSON snapshot; 'prom' =
                            Prometheus text, same registry as GET /stats)
+    trace [id|chrome [f]]  distributed tracing: no arg = recent trace
+                           ids in the ring; '<trace id>' = that trace's
+                           span tree; 'chrome [file]' = Perfetto/Chrome
+                           trace-event dump (stdout or file)
+    dump [n]               flight-recorder dump: last n (default 40)
+                           structured events + span count (the
+                           reference's dumpTables analogue)
     stt <port>             start REST proxy server
     stp                    stop REST proxy server
     pst <host:port>        switch backend to a REST proxy (client)
@@ -108,6 +115,41 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                     import json as _json
                     print(_json.dumps(node.get_metrics(), indent=2,
                                       sort_keys=True))
+            elif op == "trace":
+                import json as _json
+                from .. import tracing
+                from ..testing.trace_assembler import assemble_trace
+                tr = tracing.get_tracer()
+                if rest and rest[0] == "chrome":
+                    dump = tracing.to_chrome_trace(tr.records())
+                    if len(rest) > 1:
+                        with open(rest[1], "w") as fh:
+                            _json.dump(dump, fh)
+                        print("%d trace events -> %s (load in "
+                              "ui.perfetto.dev)" % (
+                                  len(dump["traceEvents"]), rest[1]))
+                    else:
+                        print(_json.dumps(dump))
+                elif rest:
+                    tree = assemble_trace([tr], rest[0])
+                    print(_json.dumps(tree, indent=2, sort_keys=True))
+                else:
+                    seen = {}
+                    for s in tr.spans():
+                        seen.setdefault(s["trace_id"], [0, s["name"]])
+                        seen[s["trace_id"]][0] += 1
+                    for tid_, (cnt, name) in list(seen.items())[-20:]:
+                        print("  %s  %3d spans  (%s)" % (tid_, cnt, name))
+                    print("%d trace(s) in the ring" % len(seen))
+            elif op == "dump":
+                import json as _json
+                n = int(rest[0]) if rest else 40
+                d = node.get_flight_recorder(limit=n)
+                print(_json.dumps(d["events"], indent=2, sort_keys=True))
+                print("flight recorder: %d/%d events shown, %d spans, "
+                      "ring capacity %d" % (len(d["events"]), n,
+                                            len(d["spans"]),
+                                            d["capacity"]))
             elif op == "ll":
                 d = node._dht
                 for af in (socket.AF_INET,):

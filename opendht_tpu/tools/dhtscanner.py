@@ -1,18 +1,30 @@
 """dhtscanner: census the network by walking the keyspace
 (↔ reference tools/dhtscanner.cpp:40-135: search successive ids spread
-over the ring, collecting every node seen in replies)."""
+over the ring, collecting every node seen in replies).
+
+``--json`` (ISSUE-4 satellite) emits one machine-readable document —
+the scanning node's topology snapshot (node id, per-bucket fill,
+known-node count, storage size, recent flight-recorder events) plus
+the discovered peer map — so the cluster harness can diff topology
+over a soak run instead of scraping human output."""
 
 from __future__ import annotations
 
+import json
 import socket
 import sys
 import time
 
 from ..infohash import InfoHash
-from .common import make_arg_parser, print_node_info, setup_node
+
+# .common imports the crypto layer at module scope; keep it a CALL-time
+# dependency so the scan/snapshot helpers import (and the soak harness
+# runs) without the `cryptography` wheel — same pattern as the lazy
+# crypto re-exports in opendht_tpu/__init__.py
 
 
-def scan(node, rounds: int = 32, timeout: float = 15.0) -> dict:
+def scan(node, rounds: int = 32, timeout: float = 15.0,
+         quiet: bool = False) -> dict:
     """Issue `rounds` gets at ids evenly spaced over the 160-bit ring;
     harvest the union of nodes from the routing table after each
     (dhtscanner.cpp:52-99 steps a prefix counter the same way)."""
@@ -28,18 +40,74 @@ def scan(node, rounds: int = 32, timeout: float = 15.0) -> dict:
             time.sleep(0.02)
         for nid, addr in (done[0] if done else []):
             seen[nid] = addr
-        print("scan %2d/%d: target %s…, %d nodes known"
-              % (i + 1, rounds, str(target)[:8], len(seen)))
+        if not quiet:
+            print("scan %2d/%d: target %s…, %d nodes known"
+                  % (i + 1, rounds, str(target)[:8], len(seen)))
     return seen
 
 
+def topology_snapshot(node) -> dict:
+    """Per-node topology/routing snapshot off ``get_metrics()`` + the
+    flight-recorder ring: stable keys, JSON-able values, cheap enough
+    to take every soak tick.  Every section degrades to empty rather
+    than raising (a half-up node must still snapshot)."""
+    snap: dict = {
+        "node_id": str(node.get_node_id()),
+        "port": node.get_bound_port(),
+        "routing": {},
+        "bucket_fill": [],
+        "known_nodes": 0,
+        "storage": {},
+        "metrics_gauges": {},
+        "events": [],
+    }
+    try:
+        metrics = node.get_metrics()
+        snap["metrics_gauges"] = {
+            k: v for k, v in metrics.get("gauges", {}).items()
+            if k.startswith(("dht_routing_", "dht_scheduler_"))}
+    except Exception:
+        pass
+    for af, fam in ((socket.AF_INET, "ipv4"), (socket.AF_INET6, "ipv6")):
+        try:
+            st = node.get_node_stats(af)
+            snap["routing"][fam] = st.to_dict()
+            snap["known_nodes"] += st.get_known_nodes()
+        except Exception:
+            continue
+    try:
+        table = node._dht.tables[socket.AF_INET]
+        snap["bucket_fill"] = [int(c) for c in table.bucket_occupancy()]
+    except Exception:
+        pass
+    try:
+        dht = node._dht
+        snap["storage"] = {
+            "keys": len(dht.store),
+            "values": int(dht.total_values),
+            "bytes": int(dht.total_store_size),
+        }
+    except Exception:
+        pass
+    try:
+        snap["events"] = node.get_flight_recorder(limit=50)["events"]
+    except Exception:
+        pass
+    return snap
+
+
 def main(argv=None) -> int:
+    from .common import make_arg_parser, print_node_info, setup_node
     p = make_arg_parser("OpenDHT-TPU network scanner")
     p.add_argument("--rounds", type=int, default=32,
                    help="number of keyspace probes")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON document (topology snapshot + "
+                        "discovered peers) instead of human output")
     args = p.parse_args(argv)
     node = setup_node(args)
-    print_node_info(node)
+    if not args.json:
+        print_node_info(node)
     try:
         # wait for connectivity before scanning (dhtscanner.cpp:109-117)
         from ..runtime.config import NodeStatus
@@ -47,13 +115,26 @@ def main(argv=None) -> int:
         while (node.get_status() is not NodeStatus.CONNECTED
                and time.monotonic() - t0 < 30.0):
             time.sleep(0.1)
-        seen = scan(node, args.rounds)
-        print("\n%d nodes discovered:" % len(seen))
-        for nid, addr in sorted(seen.items(), key=lambda kv: str(kv[0])):
-            print("  %s  %s" % (nid, addr))
+        seen = scan(node, args.rounds, quiet=args.json)
         stats = node.get_node_stats(socket.AF_INET)
-        print("network size estimation: %d"
-              % stats.get_network_size_estimation())
+        if args.json:
+            doc = {
+                "snapshot": topology_snapshot(node),
+                "discovered": sorted(
+                    ([str(nid), [str(addr.ip), addr.port]]
+                     for nid, addr in seen.items()),
+                    key=lambda kv: kv[0]),
+                "network_size_estimation":
+                    stats.get_network_size_estimation(),
+            }
+            json.dump(doc, sys.stdout)
+            print()
+        else:
+            print("\n%d nodes discovered:" % len(seen))
+            for nid, addr in sorted(seen.items(), key=lambda kv: str(kv[0])):
+                print("  %s  %s" % (nid, addr))
+            print("network size estimation: %d"
+                  % stats.get_network_size_estimation())
     finally:
         node.join()
     return 0

@@ -1,12 +1,13 @@
-"""tools/compat_check.py must pass all 10 scripted wire exchanges
-against this package's own live node (round-4 verdict ask #8: the
-stage-4 interop acceptance, runnable today against ourselves and
-against a reference C++ dhtnode the day one is reachable)."""
+"""tools/compat_check.py must pass all scripted wire exchanges against
+this package's own live node (round-4 verdict ask #8: the stage-4
+interop acceptance, runnable today against ourselves and against a
+reference C++ dhtnode the day one is reachable; ISSUE-4 added the
+trace-context / unknown-top-level-key interop pair)."""
 
 import pytest
 
 from opendht_tpu.runtime.runner import DhtRunner
-from opendht_tpu.tools.compat_check import run_checks
+from opendht_tpu.tools.compat_check import N_CHECKS, run_checks
 
 pytestmark = pytest.mark.quick
 
@@ -21,4 +22,4 @@ def test_compat_check_against_own_node():
         runner.shutdown()
         runner.join()
     failed = [(n, d) for n, ok, d in results if not ok]
-    assert len(results) == 10 and not failed, failed
+    assert len(results) == N_CHECKS and not failed, failed

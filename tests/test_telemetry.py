@@ -244,22 +244,13 @@ def _counter_value(name, **labels):
 def test_request_lifecycle_counters_and_message_stats():
     """Scripted exchange: every RPC type once; asserts BOTH the
     MessageStats island (get_node_message_stats in/out + reset-on-read)
-    and the registry mirrors/lifecycle series advanced together."""
+    and the registry mirrors/lifecycle series advanced together.  The
+    registry deltas go through ``snapshot_diff`` (ISSUE-4 satellite)
+    instead of hand-rolled before/after subtraction."""
     from opendht_tpu.core.value import Query, Value
 
     reg = telemetry.get_registry()
-    before = {
-        "sent_ping": _counter_value("dht_net_requests_sent_total",
-                                    type="ping"),
-        "done_ping": _counter_value("dht_net_requests_completed_total",
-                                    type="ping"),
-        "in_ping": _counter_value("dht_net_messages_total",
-                                  direction="in", type="ping"),
-        "out_put": _counter_value("dht_net_messages_total",
-                                  direction="out", type="put"),
-    }
-    rtt = reg.histogram("dht_net_rtt_seconds", type="ping")
-    rtt0 = rtt.count
+    before = reg.snapshot()
 
     net = _Net()
     a, addr_a = net.make_engine("alice", 1)
@@ -288,15 +279,15 @@ def test_request_lifecycle_counters_and_message_stats():
 
     # the registry mirrors advanced with the island (no reset: the
     # registry is cumulative — Prometheus counters never rewind)
-    assert _counter_value("dht_net_requests_sent_total",
-                          type="ping") == before["sent_ping"] + 1
-    assert _counter_value("dht_net_requests_completed_total",
-                          type="ping") == before["done_ping"] + 1
-    assert _counter_value("dht_net_messages_total", direction="in",
-                          type="ping") == before["in_ping"] + 1
-    assert _counter_value("dht_net_messages_total", direction="out",
-                          type="put") == before["out_put"] + 1
-    assert rtt.count == rtt0 + 1
+    d = telemetry.snapshot_diff(before, reg.snapshot())
+    assert d["counters"]['dht_net_requests_sent_total{type="ping"}'] == 1
+    assert d["counters"][
+        'dht_net_requests_completed_total{type="ping"}'] == 1
+    assert d["counters"][
+        'dht_net_messages_total{direction="in",type="ping"}'] == 1
+    assert d["counters"][
+        'dht_net_messages_total{direction="out",type="put"}'] == 1
+    assert d["histograms"]['dht_net_rtt_seconds{type="ping"}']["count"] == 1
 
 
 def test_request_expiry_and_timeout_counters():

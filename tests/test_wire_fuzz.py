@@ -302,6 +302,104 @@ def test_tid_collisions_between_request_and_fragment():
     assert engine_state_clean(eng)
 
 
+def test_unknown_toplevel_keys_parse_and_interop():
+    """ISSUE-4 wire compat: a msgpack map with unknown top-level keys —
+    including a hostile multi-KB fake trace blob — must parse cleanly,
+    be served like any well-formed request, and never echo the blob.
+    This is exactly what a pre-trace parser sees from a tracing peer
+    (the ``tr`` key is 'unknown' to it), so it doubles as the
+    old-parser interop proof."""
+    pings = []
+    sent = []
+    clock = FakeClock()
+    sched = Scheduler(clock=clock)
+    cbs = EngineCallbacks()
+    cbs.on_ping = lambda node: pings.append(node)
+    eng = NetworkEngine(InfoHash.get("tgt"), 0,
+                        lambda d, a: sent.append(d) or 0, sched, cbs)
+    nid = bytes(InfoHash.get("peer"))
+    blob = b"\xbb" * 262144
+    pkt = msgpack.packb(
+        {"a": {"id": nid}, "q": "ping", "t": pack_tid(1), "y": "q",
+         "v": "RNG1", "zz_future": blob, "another_unknown": [1, {"x": 2}],
+         "tr": blob},                       # oversized trace blob too
+        use_bin_type=True)
+    eng.process_message(pkt, SRC)
+    assert len(pings) == 1                  # served normally
+    assert len(sent) == 1                   # pong went out
+    assert blob[:64] not in sent[0]         # nothing echoed
+    assert len(sent[0]) < 256               # reply is the normal pong
+    assert engine_state_clean(eng)
+
+
+def test_hostile_trace_blobs_never_crash_or_record():
+    """Every malformed shape of the ``tr`` key decodes to None (no
+    span recorded, no crash); only the exact 16B/8B/int shape yields a
+    context."""
+    from opendht_tpu import tracing
+    from opendht_tpu.net.parsed_message import ParsedMessage
+
+    nid = bytes(InfoHash.get("peer"))
+    hostile_trs = [
+        b"\xaa" * (1 << 20),                       # 1 MiB blob
+        "a string", 12345, [1, 2, 3],
+        {},                                        # empty map
+        {"i": b"\x01" * 15, "s": b"\x02" * 8, "f": 1},       # short i
+        {"i": b"\x01" * 17, "s": b"\x02" * 8, "f": 1},       # long i
+        {"i": b"\x01" * 16, "s": b"\x02" * 7, "f": 1},       # short s
+        {"i": b"\x01" * 16, "s": b"\x02" * (1 << 16), "f": 1},
+        {"i": b"\x01" * 16, "s": b"\x02" * 8, "f": "x"},     # bad flags
+        {"i": 42, "s": b"\x02" * 8, "f": 1},                 # int id
+        {"i": b"\x00" * 16, "s": b"\x02" * 8, "f": 1},       # zero id
+        {"i": b"\x01" * 16, "s": b"\x02" * 8, "f": 1,
+         **{"k%d" % i: i for i in range(20)}},               # fat map
+    ]
+    for tr in hostile_trs:
+        pkt = msgpack.packb(
+            {"a": {"id": nid}, "q": "ping", "t": pack_tid(1), "y": "q",
+             "tr": tr}, use_bin_type=True)
+        msg = ParsedMessage.from_bytes(pkt)
+        assert msg.trace_ctx is None, repr(tr)[:60]
+    # the one well-formed shape decodes
+    good = {"i": b"\x01" * 16, "s": b"\x02" * 8, "f": 1}
+    pkt = msgpack.packb(
+        {"a": {"id": nid}, "q": "ping", "t": pack_tid(1), "y": "q",
+         "tr": good}, use_bin_type=True)
+    msg = ParsedMessage.from_bytes(pkt)
+    assert msg.trace_ctx is not None and msg.trace_ctx.sampled
+    assert msg.trace_ctx.to_wire() == good
+    # and an engine processing a flood of hostile-tr requests records
+    # no server spans (unsampled/undecodable) and stays clean
+    tracer = tracing.get_tracer()
+    tracer.clear()
+    eng, clock, _ = make_engine()
+    for tr in hostile_trs:
+        try:
+            data = msgpack.packb(
+                {"a": {"id": nid}, "q": "ping", "t": pack_tid(2),
+                 "y": "q", "tr": tr}, use_bin_type=True)
+        except Exception:
+            continue
+        eng.process_message(data, SRC)
+    assert not [s for s in tracer.spans() if s["kind"] == "server"]
+    clock.t += RX_MAX_PACKET_TIME + 1
+    eng.scheduler.run()
+    assert engine_state_clean(eng)
+
+
+def test_pre_trace_packet_bytes_unchanged():
+    """With no ambient trace context, outgoing queries are byte-for-byte
+    what a pre-trace build emits — no ``tr`` key, so a pre-trace golden
+    parser (and the reference) sees identical packets."""
+    eng, clock, sent = make_engine()
+    node = eng.cache.get_node(InfoHash.get("peer"), SRC, 0.0, confirm=True)
+    eng.send_ping(node)
+    assert sent
+    obj = msgpack.unpackb(sent[0][0], raw=False)
+    assert "tr" not in obj
+    assert set(obj) <= {"a", "q", "t", "y", "v", "n"}
+
+
 def test_random_garbage_corpus():
     """Pure random byte strings (seeded) across a spread of lengths."""
     eng, clock, _ = make_engine()
