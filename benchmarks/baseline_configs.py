@@ -307,15 +307,19 @@ def config4() -> dict:
     ids = jax.random.bits(key, (N, 5), dtype=jnp.uint32)
     self_id = jax.random.bits(jax.random.PRNGKey(5), (5,), dtype=jnp.uint32)
     valid = jnp.ones((N,), bool)
-    last = jnp.zeros((N,), jnp.float32)
+    # nonzero reply clocks: zeros would be "never replied" under the
+    # round-10 staleness semantics and read back as -inf bucket maxes
+    last = jax.random.uniform(jax.random.PRNGKey(6), (N,), jnp.float32,
+                              1.0, 100.0)
 
     def body(x, self_id, valid, last):
         b = radix.bucket_of(self_id, x)
         c = radix.bucket_counts(self_id, x, valid)
         s = radix.bucket_last_seen(self_id, x, valid, last)
+        # empty buckets are -inf by contract — mask before consuming
         return (jnp.sum(b.astype(jnp.float32)) * 1e-9
                 + jnp.sum(c.astype(jnp.float32))
-                + jnp.sum(s) * 1e-9)
+                + jnp.sum(jnp.where(jnp.isfinite(s), s, 0.0)) * 1e-9)
 
     # the compare-and-reduce kernels run the full sweep in ~6 ms — deep
     # rep counts keep the slope above the tunnel noise floor

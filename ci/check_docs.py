@@ -126,10 +126,20 @@ def check_config_captures(failures):
         tag = f"<!-- capture:{cname} -->"
         any_tagged = False
         for doc, lines in docs.items():
-            for ln in lines:
+            for li, ln in enumerate(lines):
                 if tag not in ln:
                     continue
                 any_tagged = True
+                # the tag's whole markdown paragraph (contiguous
+                # non-blank lines): wrapped prose puts the quoted
+                # figures on lines after the tag
+                lo = li
+                while lo > 0 and lines[lo - 1].strip():
+                    lo -= 1
+                hi = li
+                while hi + 1 < len(lines) and lines[hi + 1].strip():
+                    hi += 1
+                para = " ".join(lines[lo:hi + 1])
                 # only the line's FIRST rate figure is the artifact's
                 # primary value; later figures on the same line quote
                 # secondary fields (e.g. the latency sweep's per-wave
@@ -158,6 +168,33 @@ def check_config_captures(failures):
                                 f"{doc}: [{tag}] quotes {q}K mutations/s "
                                 f"vs captured {cap['mutations_per_s']:.0f} "
                                 f"(±15%)")
+                bound = cap.get("bound", {})
+                # round-10 maintenance attribution: the amortization
+                # factor and the per-stage ms figures quoted in the
+                # docs must track the committed capture
+                if "republish_amortization_x" in bound:
+                    for q in re.findall(r"(\d+(?:\.\d+)?)× amortization",
+                                        para):
+                        w = bound["republish_amortization_x"]
+                        if not (0.85 * w <= float(q) <= 1.15 * w):
+                            failures.append(
+                                f"{doc}: [{tag}] quotes {q}x amortization "
+                                f"vs captured {w} (±15%)")
+                    for pat, field in (
+                            (r"republish resolve (?:at )?(\d+(?:\.\d+)?) ms",
+                             "republish_batched_ms"),
+                            (r"(\d+(?:\.\d+)?) ms(?:/key| per batch-1)",
+                             "republish_per_key_ms_each"),
+                            (r"fused sweep (?:at )?(\d+(?:\.\d+)?) ms",
+                             "sweep_fused_ms"),
+                            (r"(\d+(?:\.\d+)?) ms split",
+                             "sweep_split_ms")):
+                        for q in re.findall(pat, para):
+                            w = bound[field]
+                            if not (0.85 * w <= float(q) <= 1.15 * w):
+                                failures.append(
+                                    f"{doc}: [{tag}] quotes {q} ms vs "
+                                    f"captured {field}={w} (±15%)")
                 if cap.get("unit") == "percent":
                     def _pct_band(quoted, captured, what):
                         tol = max(1.0, 0.5 * abs(captured))

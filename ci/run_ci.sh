@@ -125,6 +125,22 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "tracing overhead smoke failed"
 PY
+# maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
+# fused maintenance sweep bit-identical to the host stale set on the
+# LIVE routing table, force a bucket refresh + a due republish, and
+# assert the dht_maintenance_* counters advanced with the refresh
+# find_nodes actually on the wire.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+import importlib.util, pathlib
+spec = importlib.util.spec_from_file_location(
+    "exp_maint_r10", pathlib.Path("benchmarks/exp_maint_r10.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "maintenance smoke failed"
+PY
 # table-sharded iterative mode on a REAL 8-device virtual mesh.  The
 # heredoc (rather than env vars + the module CLI) is deliberate: on
 # hosts that register an accelerator backend via sitecustomize, the

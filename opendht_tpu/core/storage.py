@@ -89,6 +89,11 @@ class Storage:
 
     def __init__(self, now: float = 0.0):
         self.maintenance_time = now          # next republish sweep
+        # armed by Dht.storage_store on a maintain_storage node (the
+        # reference schedules dataPersistence only there, dht.cpp:
+        # 1193-1228); listen-created storages are NEVER maintenance-
+        # swept — the round-10 calendar checks this flag
+        self.maintenance_armed = False
         self.values: List[ValueStorage] = []
         self.total_size = 0
         # remote listeners: node -> {socket id -> Listener}

@@ -27,10 +27,12 @@ targets.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .. import telemetry
@@ -311,6 +313,8 @@ class NodeTable:
         # routing_table.h:31-45)
         self._cached: dict[int, tuple[bytes, Any]] = {}
         self._version = 0
+        self._maint_key = None            # reusable refresh-target PRNG
+                                          # key (lazy; split per use)
         self._snap: Optional[Snapshot] = None
         # in-flight background compaction: dispatched device arrays +
         # the mutation log to replay at swap (see _start_compaction)
@@ -844,18 +848,51 @@ class NodeTable:
         """Occupied buckets with no *reply* within `age` seconds — incl.
         never-replied buckets, which the reference marks stale from birth
         (Bucket::time = time_point::min(); bucketMaintenance's 10-min
-        rule, src/dht.cpp:1780-1838, src/routing_table.cpp:210-211)."""
-        last = np.full(radix.ID_BITS, -np.inf)
-        rows = self._valid & (self._time_reply > 0)
-        np.maximum.at(last, self._bucket[rows], self._time_reply[rows])
+        rule, src/dht.cpp:1780-1838, src/routing_table.cpp:210-211).
+        Computed by the device compare-and-reduce (ops/radix.py
+        bucket_last_seen, which owns the never-replied semantics — the
+        host ``np.maximum.at`` duplicate this replaced diverged from it)."""
+        last = np.asarray(radix.bucket_last_seen(
+            jnp.asarray(self.self_limbs), jnp.asarray(self._ids),
+            jnp.asarray(self._valid), jnp.asarray(self._time_reply)))
         occupied = self._bucket_count > 0
         return np.nonzero(occupied & (last < now - age))[0]
 
-    def refresh_targets(self, buckets, key) -> np.ndarray:
+    def _next_maint_key(self):
+        """Thread the table's reusable maintenance PRNG key (minted once
+        at construction; split per use — no fresh PRNGKey per tick)."""
+        if self._maint_key is None:
+            self._maint_key = jax.random.PRNGKey(
+                int.from_bytes(os.urandom(4), "big"))
+        self._maint_key, sub = jax.random.split(self._maint_key)
+        return sub
+
+    def maintenance_sweep(self, now: float, age: float = NODE_EXPIRE_TIME,
+                          key=None):
+        """ONE fused device pass over the slab: occupancy, per-bucket
+        last-reply staleness (never-replied ⇒ stale from birth), and a
+        refresh target inside every stale bucket
+        (↔ Dht::bucketMaintenance, src/dht.cpp:1780-1838 +
+        RoutingTable::randomId) — replaces the stale_buckets +
+        refresh_targets pair with a single launch.
+
+        Returns ``(stale, targets)``: stale bucket indices [B] int64 and
+        their refresh ids [B, 5] uint32."""
+        counts, _last, stale, targets = radix.maintenance_sweep(
+            jnp.asarray(self.self_limbs), jnp.asarray(self._ids),
+            jnp.asarray(self._valid), jnp.asarray(self._time_reply),
+            now, age, key if key is not None else self._next_maint_key())
+        stale = np.nonzero(np.asarray(stale))[0]
+        return stale, np.asarray(targets)[stale]
+
+    def refresh_targets(self, buckets, key=None) -> np.ndarray:
         """Random lookup target inside each given bucket (↔
-        RoutingTable::randomId, src/routing_table.cpp:67-85).  → [B,5]."""
+        RoutingTable::randomId, src/routing_table.cpp:67-85).  → [B,5].
+        With ``key=None`` the table's reusable maintenance key is
+        threaded (split per call) instead of minting a fresh PRNGKey."""
         out = radix.random_id_in_bucket(
-            jnp.asarray(self.self_limbs), jnp.asarray(np.asarray(buckets)), key
+            jnp.asarray(self.self_limbs), jnp.asarray(np.asarray(buckets)),
+            key if key is not None else self._next_maint_key()
         )
         return np.asarray(out)
 

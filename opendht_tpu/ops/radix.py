@@ -11,10 +11,15 @@ vectorizes that partition and the maintenance sweeps built on it:
 - ``bucket_counts``   per-bucket occupancy via a fused [160, N]
                       compare-and-reduce (segment scatters are
                       serialization-bound on TPU — see its docstring)
-- ``bucket_last_seen``per-bucket max last-reply time (device-side variant
-  of the staleness sweep; NodeTable.stale_buckets uses a host-side numpy
-  reduction with never-replied semantics,
-                      ↔ bucketMaintenance's 10-min rule, src/dht.cpp:1780-1838)
+- ``bucket_last_seen``per-bucket max last-reply time with the reference's
+                      never-replied-is-stale semantics (a bucket whose
+                      peers never replied reads -inf, ↔ Bucket::time =
+                      time_point::min(); bucketMaintenance's 10-min rule,
+                      src/dht.cpp:1780-1838) — the single source of truth
+                      NodeTable.stale_buckets delegates to
+- ``maintenance_sweep`` ONE fused pass: occupancy + staleness + a refresh
+                      target per bucket — the round-10 device sweep
+                      behind ``Dht::bucketMaintenance``
 - ``random_id_in_bucket`` uniform id inside a bucket's range
                       (↔ RoutingTable::randomId, src/routing_table.cpp:67-85)
 - ``estimate_network_size`` 8·2^depth (↔ callbacks.h:54)
@@ -61,16 +66,56 @@ def bucket_counts(self_id, ids, valid):
 
 @jax.jit
 def bucket_last_seen(self_id, ids, valid, last_seen):
-    """Per-bucket max of `last_seen` (float32/float64 [N]) over valid rows.
-    Buckets with no valid node get -inf.  [160].
+    """Per-bucket max of `last_seen` (float32/float64 [N]) over valid
+    rows THAT EVER REPLIED (``last_seen > 0``).  Buckets with no such
+    node get -inf — the reference's never-replied-is-stale rule
+    (Bucket::time starts at time_point::min(),
+    src/routing_table.cpp:210-211), so a bucket occupied only by
+    never-replied peers is stale from birth.  [160].
 
     Same compare-and-reduce form as :func:`bucket_counts` (a
     ``segment_max`` scatter measured ~45x slower at 10M rows)."""
     b = bucket_of(self_id, ids)
-    vals = jnp.where(valid, last_seen, -jnp.inf)
+    vals = jnp.where(valid & (last_seen > 0), last_seen, -jnp.inf)
     probes = jnp.arange(ID_BITS, dtype=jnp.int32)[:, None]
     masked = jnp.where(b[None, :] == probes, vals[None, :], -jnp.inf)
     return jnp.max(masked, axis=1)
+
+
+@jax.jit
+def maintenance_sweep(self_id, ids, valid, last_reply, now, age, key):
+    """The fused bucket-maintenance pass (round 10): ONE launch over the
+    [N, 5] id matrix computing everything ``Dht::bucketMaintenance``
+    (src/dht.cpp:1780-1838) needs —
+
+    - ``counts``  int32 [160]   bucket occupancy
+    - ``last``    float [160]   per-bucket last reply (-inf when the
+                                bucket never heard a reply: never-replied
+                                peers are stale from birth)
+    - ``stale``   bool  [160]   occupied & silent for ``age`` seconds
+                                (the 10-min rule)
+    - ``targets`` uint32 [160,5] a uniform refresh id inside EVERY
+                                bucket's range (↔ RoutingTable::randomId);
+                                the caller selects the stale rows
+
+    The bucket compare ([160, N] broadcast) is computed once and shared
+    by the occupancy sum and the staleness max — the same orientation as
+    :func:`bucket_counts` (scatter forms measured 45x slower; see its
+    docstring).  Targets are generated for all 160 buckets so the output
+    shape is static; at [160, 5] the wasted rows are noise next to the
+    [160, N] reduction.
+    """
+    b = bucket_of(self_id, ids)
+    bm = jnp.where(valid, b, -1)
+    probes = jnp.arange(ID_BITS, dtype=jnp.int32)[:, None]
+    hit = bm[None, :] == probes                       # [160, N]
+    counts = jnp.sum(hit, axis=1).astype(jnp.int32)
+    vals = jnp.where(valid & (last_reply > 0), last_reply, -jnp.inf)
+    last = jnp.max(jnp.where(hit, vals[None, :], -jnp.inf), axis=1)
+    stale = (counts > 0) & (last < now - age)
+    targets = random_id_in_bucket(
+        self_id, jnp.arange(ID_BITS, dtype=jnp.int32), key)
+    return counts, last, stale, targets
 
 
 # host-precomputed prefix masks: row b = mask of the first b bits

@@ -8,6 +8,7 @@ from .sharded import (  # noqa: F401
     sharded_expand_table,
     sharded_window_lookup,
     sharded_lookup,
+    sharded_maintenance_sweep,
     dp_simulate_lookups,
     tp_simulate_lookups,
 )

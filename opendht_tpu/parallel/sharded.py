@@ -463,6 +463,72 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
     return out
 
 
+@functools.lru_cache(maxsize=8)
+def _build_sharded_maintenance(mesh: Mesh):
+    from ..ops import radix
+
+    def local(self_id, ids, valid, last_reply, now, age, key):
+        # per-shard [160, N_s] compare-and-reduce, then one collective
+        # per statistic: occupancy sums (int32 — exact) and last-reply
+        # maxes (max of per-shard maxes — exact) over the table axis
+        counts = lax.psum(radix.bucket_counts(self_id, ids, valid), "t")
+        last = lax.pmax(
+            radix.bucket_last_seen(self_id, ids, valid, last_reply), "t")
+        stale = (counts > 0) & (last < now - age)
+        # refresh ids depend only on (self_id, key) — replicated compute,
+        # bit-identical to the single-device radix call (same key, same
+        # shape => same threefry stream)
+        targets = radix.random_id_in_bucket(
+            self_id, jnp.arange(radix.ID_BITS, dtype=jnp.int32), key)
+        return counts, last, stale, targets
+
+    fn = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P("t", None), P("t"), P("t"), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(None, None)),
+        **_SM_KW,
+    )
+    return jax.jit(fn)
+
+
+def sharded_maintenance_sweep(mesh: Mesh, self_id, ids, valid, last_reply,
+                              now, age, key):
+    """tp twin of :func:`opendht_tpu.ops.radix.maintenance_sweep` (round
+    10): the fused bucket-maintenance pass — occupancy + per-bucket
+    last-reply staleness (never-replied ⇒ stale from birth) + a refresh
+    target per bucket — over an [N, 5] id matrix ROW-SHARDED across the
+    ``t`` axis, so tables past one chip's HBM sweep in one launch.
+
+    Per shard the [160, N_s] compare-and-reduce runs locally; the only
+    ICI traffic is one [160]-int32 psum (occupancy) and one [160]-float
+    pmax (staleness) — O(buckets), never O(table).  Results are
+    BIT-IDENTICAL to the single-device kernel on the same inputs
+    (integer sums and maxes are exact under resharding; asserted in
+    tests/test_sharded.py).
+
+    ids: uint32 [N, 5] with N divisible by mesh.shape['t'] (pad with
+    ``valid=False`` rows via :func:`pad_to_multiple`).  Returns
+    (counts [160] int32, last [160], stale [160] bool,
+    targets [160, 5] uint32), all replicated.
+    """
+    N = ids.shape[0]
+    if N % mesh.shape["t"]:
+        raise ValueError(f"table rows ({N}) not divisible by "
+                         f"t={mesh.shape['t']}; pad via pad_to_multiple")
+    if valid is None:
+        valid = jnp.ones((N,), bool)
+    fn = _build_sharded_maintenance(mesh)
+    from .. import telemetry
+    reg = telemetry.get_registry()
+    reg.counter("dht_maintenance_sweeps_total", mode="tp").inc()
+    with reg.span("dht_maintenance_sweep_seconds", mode="tp"):
+        out = fn(jnp.asarray(self_id, _U32), jnp.asarray(ids, _U32),
+                 jnp.asarray(valid), jnp.asarray(last_reply),
+                 jnp.asarray(now), jnp.asarray(age), key)
+        jax.block_until_ready(out)
+    return out
+
+
 def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):
     """Data-parallel batched iterative lookups: targets sharded over the
     whole mesh (both axes), sorted table replicated.  The per-step merge

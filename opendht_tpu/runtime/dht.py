@@ -28,8 +28,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import tracing
+from .. import telemetry, tracing
 from ..infohash import InfoHash
+from ..ops import ids as IK
 from ..sockaddr import SockAddr
 from ..scheduler import Scheduler
 from ..utils import TIME_MAX, WANT4, WANT6, wall_now
@@ -62,6 +63,13 @@ MAX_HASHES = 16384                   # stored keys cap (dht.h:327)
 MAX_SEARCHES = 16384                 # concurrent searches cap (dht.h:330)
 TOKEN_SIZE = 32                      # sha256 digest length (dht.h:342)
 MAX_STORAGE_MAINTENANCE_EXPIRE_TIME = 10 * 60.0    # (dht.h:335)
+
+#: storage-calendar quantum (round 10): per-key expiry/republish jobs
+#: are binned to this many seconds and every bin shares ONE scheduler
+#: heap entry, so K stored keys cost O(bins in flight) entries, not K.
+#: Bins round UP, so no sweep ever fires before a key is due; the ≤10 s
+#: lateness is noise against the 10-min expiry/republish horizons.
+STORAGE_CALENDAR_QUANTUM = 10.0
 
 #: the query standing for a token-only sync probe ('find_node' path)
 _ANY_QUERY = Query(none=True)
@@ -160,6 +168,21 @@ class Dht:
         self._last_status = {af: NodeStatus.DISCONNECTED for af in self.tables}
         self._status_checked: Dict[int, float] = {}
         self._status_recheck: Dict[int, object] = {}
+
+        # storage calendar (round 10): bin id -> keys due at that bin;
+        # one scheduler job per OCCUPIED bin replaces the per-key
+        # _data_persistence/_expire_storage jobs (see _calendar_add)
+        self._storage_calendar: Dict[int, set] = {}
+
+        # maintenance telemetry (ISSUE-5): handles cached once
+        _reg = telemetry.get_registry()
+        self._m_maint_sweeps = _reg.counter("dht_maintenance_sweeps_total")
+        self._m_maint_refresh = _reg.counter(
+            "dht_maintenance_refresh_sent_total")
+        self._m_maint_due = _reg.counter("dht_maintenance_due_keys_total")
+        self._m_maint_republished = _reg.counter(
+            "dht_maintenance_republished_values_total")
+        self._m_calendar_bins = _reg.gauge("dht_maintenance_calendar_bins")
 
         # write-token secrets, rotated every 15-45 min (dht.cpp:1369-1379)
         self._secret = os.urandom(8)
@@ -1006,8 +1029,7 @@ class Dht:
                 self.storage_store(key, value, now)
                 new_exp = local_expiration()
             if new_exp is not None:
-                self.scheduler.add(new_exp,
-                                   lambda: self._expire_storage(key))
+                self._calendar_add(key, new_exp)
                 arm(new_exp - REANNOUNCE_MARGIN)
             else:
                 arm(now + max(ttl - REANNOUNCE_MARGIN, 1.0))
@@ -1172,8 +1194,8 @@ class Dht:
             st = self.store[key] = Storage(now)
             if self.maintain_storage:
                 st.maintenance_time = now + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
-                self.scheduler.add(st.maintenance_time,
-                                   lambda: self._data_persistence(key))
+                st.maintenance_armed = True
+                self._calendar_add(key, st.maintenance_time)
         bucket = None
         if sa is not None:
             bucket = self.store_quota.setdefault(_quota_key(sa),
@@ -1182,8 +1204,7 @@ class Dht:
         if vs is not None:
             self.total_store_size += diff.size_diff
             self.total_values += diff.values_diff
-            self.scheduler.add(expiration,
-                               lambda: self._expire_storage(key))
+            self._calendar_add(key, expiration)
             if self.total_store_size > self.max_store_size:
                 self._expire_store_all()
             self._storage_changed(key, st, vs.data, diff.values_diff > 0)
@@ -1284,19 +1305,168 @@ class Dht:
         for k in [k for k, b in self.store_quota.items() if b.size == 0]:
             del self.store_quota[k]
 
+    # ------------------------------------------------- storage calendar
+    def _calendar_add(self, key: InfoHash, when: float) -> None:
+        """Enqueue `key` for a storage sweep (expiry + republish check)
+        at `when`.  Keys binned to the same STORAGE_CALENDAR_QUANTUM
+        share ONE scheduler job — the round-10 replacement for the
+        per-key ``_data_persistence``/``_expire_storage`` jobs whose
+        heap entries scaled with the stored-key count.  Bins round UP
+        so the sweep never fires before the key is due."""
+        b = -int(-when // STORAGE_CALENDAR_QUANTUM)          # ceil
+        s = self._storage_calendar.get(b)
+        if s is None:
+            self._storage_calendar[b] = s = set()
+            self.scheduler.add(b * STORAGE_CALENDAR_QUANTUM,
+                               lambda: self._calendar_fire(b))
+            self._m_calendar_bins.set(len(self._storage_calendar))
+        s.add(key)
+
+    def _calendar_fire(self, b: int) -> None:
+        """One calendar bin came due: run value expiry per key, then
+        republish EVERY due key through one batched resolve.
+
+        Loss profile under a raising callback (a local listener's
+        ``get_cb`` runs inside the expiry): the per-key jobs this bin
+        replaced lost only the raising key, so the untouched remainder
+        of the bin is re-binned for the next tick instead of being
+        dropped with the popped set."""
+        keys = self._storage_calendar.pop(b, None)
+        self._m_calendar_bins.set(len(self._storage_calendar))
+        if not keys:
+            return
+        now = self.scheduler.time()
+        due = []
+        pending = sorted(keys, key=bytes, reverse=True)
+        try:
+            while pending:
+                key = pending.pop()
+                self._expire_storage(key)
+                st = self.store.get(key)
+                # republish only keys storage_store ARMED (the reference
+                # never maintains listen-created storages); due when
+                # `maintenance_time <= now`: `<` (not `<=`) so a
+                # discrete-event driver landing exactly on
+                # maintenance_time still republishes and reschedules
+                if st is not None and self.maintain_storage \
+                        and st.maintenance_armed \
+                        and not now < st.maintenance_time:
+                    due.append(key)
+        except BaseException:
+            for key in pending:
+                self._calendar_add(key, now)
+            for key in due:
+                self._calendar_add(key, now)
+            raise
+        if due:
+            self._storage_maintenance_batched(due)
+
     def _data_persistence(self, key: InfoHash) -> None:
-        """Republish stored values toward closer nodes before expiry
-        (↔ Dht::dataPersistence, src/dht.cpp:1840-1852)."""
+        """Republish one key's stored values toward closer nodes before
+        expiry (↔ Dht::dataPersistence, src/dht.cpp:1840-1852).  Single-
+        key entry kept for direct callers; the calendar sweep
+        (:meth:`_calendar_fire`) batches whole due sets into one device
+        resolve instead of scheduling this per key."""
         st = self.store.get(key)
         now = self.scheduler.time()
         # run when due; `<` (not `<=`) so a discrete-event driver that lands
         # exactly on maintenance_time still republishes and reschedules
         if st is None or now < st.maintenance_time:
             return
-        self._maintain_storage(key, st)
-        st.maintenance_time = now + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
-        self.scheduler.add(st.maintenance_time,
-                           lambda: self._data_persistence(key))
+        self._storage_maintenance_batched([key])
+
+    def _republish_predicate(self, keys: List[InfoHash], af: int
+                             ) -> List[bool]:
+        """The "no longer among the k closest" test for MANY keys from
+        ONE batched closest-k resolve (↔ the per-key
+        ``find_closest_nodes`` + ``xor_cmp`` in Dht::maintainStorage,
+        src/dht.cpp:1854-1900).  For each key the last addr-servable
+        row stands in for ``find_closest_nodes(key, af)[-1]``, so the
+        decision agrees EXACTLY with the scalar path (same addr filter,
+        same `< 0` strictness on ties; pinned in
+        tests/test_maintenance.py) — including tables smaller than k
+        (the last VALID row, not the padded k-th) and empty tables
+        (no nodes ⇒ no republish, family keeps responsibility)."""
+        table = self._table(af)
+        out = [False] * len(keys)
+        if table is None or len(table) == 0 or not keys:
+            return out
+        rows, _dist = table.find_closest(list(keys), k=TARGET_NODES,
+                                         now=self.scheduler.time())
+        last_rows = np.full(len(keys), -1, dtype=np.int64)
+        for qi in range(rows.shape[0]):
+            for j in range(rows.shape[1] - 1, -1, -1):
+                r = int(rows[qi, j])
+                if r >= 0 and table.addr_of(r) is not None:
+                    last_rows[qi] = r
+                    break
+        kth_ids = table.ids_of_rows(last_rows)
+        for qi, key in enumerate(keys):
+            if last_rows[qi] >= 0:
+                out[qi] = key.xor_cmp(kth_ids[qi], self.myid) < 0
+        return out
+
+    def _storage_maintenance_batched(self, keys: List[InfoHash]) -> int:
+        """Republish every due key (↔ Dht::dataPersistence +
+        maintainStorage, src/dht.cpp:1840-1900) with ONE closest-k
+        device resolve per address family for the WHOLE due set —
+        K keys cost one lane-padded launch, not K (the round-10
+        planner; same batching move as the PR-1/PR-2 lookup path).
+        Announce fan-out, responsibility bookkeeping and the
+        not-responsible-anywhere clear are per key, exactly as the
+        scalar :meth:`_maintain_storage` does them."""
+        keys = [k for k in keys if k in self.store]
+        if not keys:
+            return 0
+        now = self.scheduler.time()
+        self._m_maint_due.inc(len(keys))
+        announced = 0
+        still = {bytes(k): {af: True for af in self.tables} for k in keys}
+        reg = telemetry.get_registry()
+        with reg.span("dht_maintenance_republish_seconds"):
+            republish = {af: self._republish_predicate(keys, af)
+                         for af in self.tables}
+        # re-schedule EVERY key before the announce fan-out: a raising
+        # callback mid-announce must not silently end the whole due
+        # set's maintenance (the per-key jobs lost only the raising
+        # key).  maintenance_armed is NOT set here — storage_store owns
+        # arming, so a direct _data_persistence call on a listen-created
+        # storage republishes once without enrolling it forever (the
+        # calendar fire keeps skipping unarmed keys)
+        for key in keys:
+            st = self.store.get(key)
+            if st is not None:
+                st.maintenance_time = now + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
+                self._calendar_add(key, st.maintenance_time)
+        for af in self.tables:
+            for key, do in zip(keys, republish[af]):
+                if not do:
+                    continue
+                st = self.store.get(key)
+                if st is None:
+                    continue
+                for vs in st.values:
+                    vt = self.types.get_type(vs.data.type)
+                    if vs.created + vt.expiration > \
+                            now + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME:
+                        self._announce(key, af, vs.data, None,
+                                       vs.created, False)
+                        announced += 1
+                still[bytes(key)][af] = False
+        for key in keys:
+            st = self.store.get(key)
+            if st is None:
+                continue
+            if self.tables and not any(still[bytes(key)].values()):
+                diff = st.clear(key)
+                self.total_store_size += diff.size_diff
+                self.total_values += diff.values_diff
+        self._m_maint_republished.inc(announced)
+        tr = tracing.get_tracer()
+        if tr.enabled:
+            tr.event("maintenance_republish", due=len(keys),
+                     announced=announced)
+        return announced
 
     def _maintain_storage(self, key: InfoHash, st: Storage,
                           force: bool = False, done_cb=None) -> int:
@@ -1449,7 +1619,7 @@ class Dht:
                                        DhtProtocolException.STORAGE_NOT_FOUND)
         # the sweep scheduled at the original expiration will now keep the
         # value; cover the extended lifetime with a new sweep
-        self.scheduler.add(new_exp, lambda: self._expire_storage(key))
+        self._calendar_add(key, new_exp)
         return RequestAnswer()
 
     # ============================================================ maintenance
@@ -1478,23 +1648,28 @@ class Dht:
 
     def _bucket_maintenance(self, af: int) -> bool:
         """Random find in stale buckets (↔ Dht::bucketMaintenance,
-        src/dht.cpp:1780-1838) — staleness computed by a device segment
-        reduction, refresh targets sampled by the radix kernel."""
+        src/dht.cpp:1780-1838) — round 10: occupancy, staleness AND the
+        refresh targets come from ONE fused device pass
+        (ops/radix.maintenance_sweep, threading the table's reusable
+        PRNG key), and the per-target node picks come from ONE batched
+        closest-node resolve instead of a single-target launch (and its
+        full 128-lane padding tax) per stale bucket."""
         table = self.tables[af]
         now = self.scheduler.time()
         if len(table) == 0:
             return False
-        stale = table.stale_buckets(now)
+        reg = telemetry.get_registry()
+        with reg.span("dht_maintenance_sweep_seconds"):
+            stale, targets = table.maintenance_sweep(now)
+        self._m_maint_sweeps.inc()
         if len(stale) == 0:
             return False
-        import jax
-        from ..ops import ids as IK
-        targets = table.refresh_targets(
-            stale, jax.random.PRNGKey(random.getrandbits(31)))
+        raw = IK.ids_to_bytes(targets)
+        tids = [InfoHash(raw[i].tobytes()) for i in range(targets.shape[0])]
+        near = self.find_closest_nodes_batched(tids, af, TARGET_NODES)
         sent = False
-        for i in range(targets.shape[0]):
-            tid = InfoHash(IK.ids_to_bytes(targets[i]).tobytes())
-            n = self._random_node_near(af, tid)
+        for tid, nodes in zip(tids, near):
+            n = random.choice(nodes) if nodes else None
             if n is not None and not n.is_pending():
                 def on_expired(req, over, _n=n):
                     if over:
@@ -1504,6 +1679,11 @@ class Dht:
                 self.engine.send_find_node(n, tid, self._want(),
                                            None, on_expired)
                 sent = True
+                self._m_maint_refresh.inc()
+        tr = tracing.get_tracer()
+        if tr.enabled:
+            tr.event("bucket_refresh", af=af, stale=int(len(stale)),
+                     sent=sent)
         return sent
 
     def _neighbourhood_maintenance(self, af: int) -> bool:

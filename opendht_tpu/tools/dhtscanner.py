@@ -59,6 +59,7 @@ def topology_snapshot(node) -> dict:
         "known_nodes": 0,
         "storage": {},
         "metrics_gauges": {},
+        "maintenance": {},
         "events": [],
     }
     try:
@@ -66,6 +67,15 @@ def topology_snapshot(node) -> dict:
         snap["metrics_gauges"] = {
             k: v for k, v in metrics.get("gauges", {}).items()
             if k.startswith(("dht_routing_", "dht_scheduler_"))}
+        # round-10 maintenance surface: sweep/refresh/republish counters
+        # + calendar-bin gauge, so the soak harness can diff how much
+        # maintenance each node actually performed between snapshots
+        snap["maintenance"] = {
+            k: v for k, v in metrics.get("counters", {}).items()
+            if k.startswith("dht_maintenance_")}
+        snap["maintenance"].update(
+            (k, v) for k, v in metrics.get("gauges", {}).items()
+            if k.startswith("dht_maintenance_"))
     except Exception:
         pass
     for af, fam in ((socket.AF_INET, "ipv4"), (socket.AF_INET6, "ipv6")):

@@ -1,0 +1,470 @@
+"""Round-10 batched maintenance sweep: the fused bucket-refresh device
+pass, the calendar-binned republish planner, and their exact agreement
+with the per-key / per-bucket scalar paths they replaced
+(↔ Dht::bucketMaintenance src/dht.cpp:1780-1838,
+Dht::dataPersistence/maintainStorage src/dht.cpp:1840-1900,
+RoutingTable::randomId src/routing_table.cpp:67-85)."""
+
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.core.table import NodeTable, NODE_EXPIRE_TIME, TARGET_NODES
+from opendht_tpu.core.value import Value, ValueType
+from opendht_tpu.ops import ids as K
+from opendht_tpu.ops import radix
+from opendht_tpu.runtime import Config, Dht
+from opendht_tpu.runtime.dht import (MAX_STORAGE_MAINTENANCE_EXPIRE_TIME,
+                                     STORAGE_CALENDAR_QUANTUM)
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+from opendht_tpu import telemetry
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
+AF = socket.AF_INET
+
+
+def _rand_hash(rng):
+    return InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+
+
+# ----------------------------------------------------------- device kernel
+
+def _scalar_sweep(me, hashes, valid, last_reply, now, age):
+    """Per-bucket scalar oracle with the reference's never-replied-is-
+    stale rule (Bucket::time = time_point::min())."""
+    counts = np.zeros(160, np.int32)
+    last = np.full(160, -np.inf)
+    for i, h in enumerate(hashes):
+        if not valid[i]:
+            continue
+        b = min(InfoHash.common_bits(me, h), 159)
+        counts[b] += 1
+        if last_reply[i] > 0:
+            last[b] = max(last[b], last_reply[i])
+    stale = (counts > 0) & (last < now - age)
+    return counts, last, stale
+
+
+def test_maintenance_sweep_matches_scalar_oracle():
+    rng = np.random.default_rng(11)
+    me = _rand_hash(rng)
+    hashes = [_rand_hash(rng) for _ in range(128)]
+    # a guaranteed never-replied-only bucket: one peer differing at bit 0
+    # with last_reply == 0 (all the random peers land in other buckets
+    # with overwhelming probability is NOT assumed — the oracle decides)
+    valid = rng.random(128) > 0.15
+    last_reply = np.where(rng.random(128) > 0.4,
+                          rng.uniform(1.0, 100.0, 128), 0.0)
+    now, age = 700.0, 600.0
+    self_l = jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1)
+    ids = jnp.asarray(K.ids_from_hashes(hashes))
+    counts, last, stale, targets = radix.maintenance_sweep(
+        self_l, ids, jnp.asarray(valid),
+        jnp.asarray(last_reply, jnp.float32), now, age,
+        jax.random.PRNGKey(5))
+    w_counts, w_last, w_stale = _scalar_sweep(
+        me, hashes, valid, last_reply.astype(np.float32), now, age)
+    np.testing.assert_array_equal(np.asarray(counts), w_counts)
+    np.testing.assert_array_equal(np.asarray(stale), w_stale)
+    got_last = np.asarray(last)
+    for b in range(160):
+        if np.isfinite(w_last[b]):
+            assert got_last[b] == pytest.approx(w_last[b])
+        else:
+            assert not np.isfinite(got_last[b])
+    # targets land inside their bucket's range for every bucket
+    raw = K.ids_to_bytes(np.asarray(targets))
+    for b in range(160):
+        h = InfoHash(raw[b].tobytes())
+        assert InfoHash.common_bits(me, h) == b
+
+    # fused sweep == the standalone kernels it fuses
+    np.testing.assert_array_equal(
+        np.asarray(counts),
+        np.asarray(radix.bucket_counts(self_l, ids, jnp.asarray(valid))))
+    np.testing.assert_array_equal(
+        np.asarray(last),
+        np.asarray(radix.bucket_last_seen(
+            self_l, ids, jnp.asarray(valid),
+            jnp.asarray(last_reply, jnp.float32))))
+
+
+def test_bucket_last_seen_never_replied_is_stale():
+    """ISSUE-5 satellite: the device kernel now honors the reference's
+    never-replied ⇒ stale-from-birth rule — a bucket whose only peers
+    have last_reply == 0 reads -inf, exactly like the host oracle
+    (the old kernel read 0.0 there and diverged from
+    NodeTable.stale_buckets)."""
+    rng = np.random.default_rng(12)
+    me = _rand_hash(rng)
+    # two peers in bucket 0 (first bit differs), neither ever replied
+    peers = []
+    while len(peers) < 2:
+        h = _rand_hash(rng)
+        if InfoHash.common_bits(me, h) == 0:
+            peers.append(h)
+    ids = jnp.asarray(K.ids_from_hashes(peers))
+    last = np.asarray(radix.bucket_last_seen(
+        jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1), ids,
+        jnp.ones(2, bool), jnp.zeros(2, jnp.float32)))
+    assert last[0] == -np.inf
+    # and a replied peer lifts it
+    last2 = np.asarray(radix.bucket_last_seen(
+        jnp.asarray(K.ids_from_bytes(bytes(me))).reshape(-1), ids,
+        jnp.ones(2, bool), jnp.asarray([0.0, 42.0], jnp.float32)))
+    assert last2[0] == pytest.approx(42.0)
+
+
+def test_node_table_sweep_matches_stale_buckets():
+    """NodeTable.maintenance_sweep (one fused launch) returns the same
+    stale set as stale_buckets, including never-replied buckets."""
+    rng = np.random.default_rng(13)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=128)
+    replied = rng.integers(0, 256, (40, 20), dtype=np.uint8)
+    hearsay = rng.integers(0, 256, (40, 20), dtype=np.uint8)
+    t.bulk_load(K.ids_from_bytes(replied), now=100.0, replied=True)
+    t.bulk_load(K.ids_from_bytes(hearsay), now=100.0, replied=False)
+    for now in (101.0, 100.0 + NODE_EXPIRE_TIME + 1, 5000.0):
+        want = t.stale_buckets(now)
+        stale, targets = t.maintenance_sweep(now)
+        np.testing.assert_array_equal(stale, want)
+        assert targets.shape == (len(stale), 5)
+        raw = K.ids_to_bytes(targets)
+        for j, b in enumerate(stale):
+            assert InfoHash.common_bits(
+                me, InfoHash(raw[j].tobytes())) == b
+    # shortly after load, only the hearsay-only (never-replied) buckets
+    # are stale — and there is at least one at these sizes
+    stale, _ = t.maintenance_sweep(101.0)
+    replied_buckets = {int(t._bucket[t.row_of(InfoHash(replied[i].tobytes()))])
+                       for i in range(40)
+                       if t.row_of(InfoHash(replied[i].tobytes())) is not None
+                       and t._time_reply[
+                           t.row_of(InfoHash(replied[i].tobytes()))] > 0}
+    assert set(stale.tolist()).isdisjoint(replied_buckets)
+
+
+def test_refresh_targets_threads_reusable_key():
+    """With no explicit key the table splits ONE reusable PRNG key per
+    call (no fresh PRNGKey mint per tick) — consecutive calls give
+    fresh targets, still inside the right buckets."""
+    rng = np.random.default_rng(14)
+    me = _rand_hash(rng)
+    t = NodeTable(me, capacity=32)
+    buckets = np.array([0, 1, 5, 42])
+    a = t.refresh_targets(buckets)
+    key_after_first = t._maint_key
+    b = t.refresh_targets(buckets)
+    assert t._maint_key is not key_after_first      # threaded, not reused
+    assert not np.array_equal(a, b)
+    for arr in (a, b):
+        raw = K.ids_to_bytes(arr)
+        for j, bk in enumerate(buckets):
+            assert InfoHash.common_bits(me, InfoHash(raw[j].tobytes())) == bk
+    # explicit keys still honored (deterministic)
+    c = t.refresh_targets(buckets, jax.random.PRNGKey(1))
+    d = t.refresh_targets(buckets, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(c, d)
+
+
+# --------------------------------------------------- responsibility predicate
+
+def _make_dht(clock=None, maintain=True):
+    sched = Scheduler(clock=clock) if clock is not None else None
+    cfg = Config()
+    cfg.maintain_storage = maintain
+    sent = []
+    dht = Dht(lambda data, addr: sent.append((data, addr)) or 0,
+              config=cfg, scheduler=sched, has_v6=False)
+    return dht, sent
+
+
+def _fill_table(dht, rng, n, now=None):
+    table = dht.tables[AF]
+    now = dht.scheduler.time() if now is None else now
+    added = 0
+    while added < n:
+        h = _rand_hash(rng)
+        if table.insert(h, SockAddr("10.0.0.%d" % (added % 250 + 1),
+                                    4000 + added),
+                        now=now, confirm=2) is not None:
+            added += 1
+    return table
+
+
+def _scalar_republish_decision(dht, key, af):
+    """The exact decision body of Dht._maintain_storage (src/dht.cpp:
+    1854-1900): republish iff closest nodes exist and the farthest of
+    them is XOR-closer to the key than we are."""
+    nodes = dht.find_closest_nodes(key, af)
+    if not nodes:
+        return False
+    return key.xor_cmp(nodes[-1].id, dht.myid) < 0
+
+
+def test_republish_predicate_matches_scalar():
+    rng = np.random.default_rng(15)
+    dht, _ = _make_dht()
+    _fill_table(dht, rng, 40)
+    keys = [_rand_hash(rng) for _ in range(64)]
+    # keys AT a table node's id and AT our own id: xor distance 0 rows
+    # and the tie-sensitive boundary
+    table = dht.tables[AF]
+    keys.append(table.id_of(next(iter(table._row_of.values()))))
+    keys.append(dht.myid)
+    got = dht._republish_predicate(keys, AF)
+    want = [_scalar_republish_decision(dht, k, AF) for k in keys]
+    assert got == want
+    assert any(got), "no key ever due — the comparison is vacuous"
+
+
+def test_republish_predicate_small_and_empty_tables():
+    rng = np.random.default_rng(16)
+    # empty table: nobody closer exists — no republish, family keeps
+    # responsibility (the scalar path `continue`s)
+    dht, _ = _make_dht()
+    keys = [_rand_hash(rng) for _ in range(5)]
+    assert dht._republish_predicate(keys, AF) == [False] * 5
+    # table smaller than k: the LAST VALID node decides (not the -1
+    # padded k-th row)
+    for n in (1, 3, TARGET_NODES - 1):
+        dht, _ = _make_dht()
+        _fill_table(dht, rng, n)
+        keys = [_rand_hash(rng) for _ in range(32)]
+        got = dht._republish_predicate(keys, AF)
+        want = [_scalar_republish_decision(dht, k, AF) for k in keys]
+        assert got == want, f"n={n}"
+        assert any(got) and not all(got), f"n={n}: boundary not exercised"
+
+
+def test_republish_predicate_ignores_addrless_rows():
+    """The scalar path builds Node objects, which silently drops rows
+    whose addr is unknown — the batched predicate must apply the same
+    filter before picking its k-th node."""
+    rng = np.random.default_rng(17)
+    dht, _ = _make_dht()
+    table = _fill_table(dht, rng, 12)
+    # strip addresses from half the rows
+    for row in list(table._row_of.values())[::2]:
+        table._addrs[row] = None
+    keys = [_rand_hash(rng) for _ in range(32)]
+    got = dht._republish_predicate(keys, AF)
+    want = [_scalar_republish_decision(dht, k, AF) for k in keys]
+    assert got == want
+
+
+# --------------------------------------------------- calendar-binned sweep
+
+def test_calendar_sweep_republishes_exactly_on_maintenance_time():
+    """Discrete-event boundary (the `<` vs `<=` comment in
+    _data_persistence): a driver whose clock lands EXACTLY on
+    maintenance_time must republish and reschedule."""
+    clock = {"t": 1000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"])
+    rng = np.random.default_rng(18)
+    _fill_table(dht, rng, 24)
+    # long-lived type so values survive past the republish horizon
+    dht.types.register_type(ValueType(7, "long", expiration=3600.0))
+    # a key we stay responsible for, so the swept value is kept (a key
+    # whose 8 closest are all closer than us would migrate + clear)
+    key = next(k for k in (_rand_hash(rng) for _ in range(256))
+               if not _scalar_republish_decision(dht, k, AF))
+    v = Value(b"keep me", value_id=3)
+    v.type = 7
+    assert dht.storage_store(key, v, clock["t"])
+    st = dht.store[key]
+    mt = st.maintenance_time
+    assert mt == 1000.0 + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
+    reg = telemetry.get_registry()
+    due0 = reg.counter("dht_maintenance_due_keys_total").value
+    # land exactly on the due time (a multiple of the calendar quantum,
+    # so the bin job is due at this very instant too)
+    assert mt % STORAGE_CALENDAR_QUANTUM == 0
+    clock["t"] = mt
+    dht.scheduler.run()
+    assert st.maintenance_time == mt + MAX_STORAGE_MAINTENANCE_EXPIRE_TIME, \
+        "key landing exactly on maintenance_time was not republished"
+    assert reg.counter("dht_maintenance_due_keys_total").value > due0
+    # the value survived (it expires at t+3600, long past the sweep)
+    assert dht.get_local(key)
+
+
+def test_calendar_sweep_announces_when_no_longer_responsible():
+    clock = {"t": 2000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"])
+    rng = np.random.default_rng(19)
+    _fill_table(dht, rng, 40)
+    dht.types.register_type(ValueType(7, "long", expiration=3600.0))
+    # pick keys the predicate marks due (all 8 closest closer than us)
+    keys = [k for k in (_rand_hash(rng) for _ in range(64))
+            if _scalar_republish_decision(dht, k, AF)][:4]
+    assert keys, "table too small to ever lose responsibility"
+    for key in keys:
+        v = Value(b"migrate", value_id=9)
+        v.type = 7
+        assert dht.storage_store(key, v, clock["t"])
+        dht.store[key].maintenance_time = clock["t"]        # force due
+    announced = dht._storage_maintenance_batched(keys)
+    assert announced == len(keys)
+    # not responsible in the only family → local copies were cleared
+    for key in keys:
+        assert not dht.get_local(key)
+
+
+def test_scheduler_heap_o1_in_stored_keys():
+    """The round-10 planner: per-key _data_persistence/_expire_storage
+    jobs are gone — K stored keys cost O(occupied calendar bins) heap
+    entries, not O(K)."""
+    clock = {"t": 5000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"])
+    base = len(dht.scheduler._heap)
+    n = 1000
+    for i in range(n):
+        assert dht.storage_store(InfoHash.get(f"cal-{i}"),
+                                 Value(b"v", value_id=1), clock["t"])
+    grown = len(dht.scheduler._heap) - base
+    # all keys share one expiry bin + one maintenance bin (same store
+    # instant); a generous band still catches any per-key scheduling
+    assert grown <= 8, \
+        f"heap grew {grown} entries for {n} stored keys (per-key jobs?)"
+    assert len(dht.store) == n
+
+
+def test_calendar_never_republishes_listen_created_storage():
+    """The reference arms dataPersistence ONLY for storages created by
+    storageStore (dht.cpp:1193-1228); a listen-created storage that
+    later receives values must not be republish-swept — and in
+    particular must NOT be cleared by a not-responsible decision."""
+    clock = {"t": 4000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"])
+    rng = np.random.default_rng(21)
+    _fill_table(dht, rng, 40)
+    dht.types.register_type(ValueType(7, "long", expiration=3600.0))
+    # a key we are NOT responsible for (the clear-risk case)
+    key = next(k for k in (_rand_hash(rng) for _ in range(256))
+               if _scalar_republish_decision(dht, k, AF))
+    dht.listen(key, lambda vals, expired: True)     # creates the storage
+    st = dht.store[key]
+    assert not st.maintenance_armed
+    v = Value(b"listener copy", value_id=4)
+    v.type = 7
+    assert dht.storage_store(key, v, clock["t"])    # existing-st branch
+    assert not st.maintenance_armed
+    reg = telemetry.get_registry()
+    due0 = reg.counter("dht_maintenance_due_keys_total").value
+    # drive past maintenance_time (st was created with it = creation
+    # time) and fire the expiry bin
+    clock["t"] += 600.0 + 2 * STORAGE_CALENDAR_QUANTUM
+    dht.scheduler.run()
+    assert reg.counter("dht_maintenance_due_keys_total").value == due0
+    assert dht.get_local(key), "listen-created storage was swept away"
+
+
+def test_calendar_fire_survives_raising_listener():
+    """A raising local-listener callback mid-bin must not drop the rest
+    of the bin's keys (the per-key jobs lost only the raising key)."""
+    clock = {"t": 6000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"], maintain=False)
+    keys = [InfoHash.get(f"bin-{i}") for i in range(8)]
+    for key in keys:
+        assert dht.storage_store(key, Value(b"v", value_id=1), clock["t"])
+    # a listener whose expiry push raises, on the lexicographically
+    # FIRST key so the failure hits before the rest of the bin
+    first = sorted(keys, key=bytes)[0]
+
+    def boom(vals, expired):
+        if expired:
+            raise RuntimeError("listener exploded")
+        return True
+    dht.listen(first, boom)
+    clock["t"] += 600.0 + STORAGE_CALENDAR_QUANTUM
+    with pytest.raises(RuntimeError):
+        dht.scheduler.run()
+    # the untouched keys were re-binned — the next tick expires them
+    clock["t"] += STORAGE_CALENDAR_QUANTUM
+    dht.scheduler.run()
+    for key in keys:
+        if key != first:
+            assert not dht.get_local(key), "re-binned key never expired"
+
+
+def test_calendar_expires_values():
+    """Value expiry rides the same calendar: a stored value is swept at
+    (or within one quantum after) its expiration, listeners told."""
+    clock = {"t": 3000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"], maintain=False)
+    key = InfoHash.get("ephemeral")
+    assert dht.storage_store(key, Value(b"gone", value_id=2), clock["t"])
+    heard = []
+    dht.listen(key, lambda vals, expired:
+               heard.extend((v.data, expired) for v in vals) or True)
+    assert (b"gone", False) in heard
+    # default type expiry is 10 min; step past it plus one bin
+    clock["t"] = 3000.0 + 600.0 + STORAGE_CALENDAR_QUANTUM
+    dht.scheduler.run()
+    assert not dht.get_local(key)
+    assert (b"gone", True) in heard, "expiry never pushed to the listener"
+
+
+# --------------------------------------------------- fused bucket refresh
+
+def test_bucket_maintenance_fires_batched_finds():
+    clock = {"t": 100.0}
+    dht, sent = _make_dht(clock=lambda: clock["t"], maintain=False)
+    rng = np.random.default_rng(20)
+    _fill_table(dht, rng, 30, now=100.0)
+    reg = telemetry.get_registry()
+    sweeps0 = reg.counter("dht_maintenance_sweeps_total").value
+    finds0 = reg.counter("dht_maintenance_refresh_sent_total").value
+    # nothing stale yet → a sweep runs but nothing is sent
+    assert dht._bucket_maintenance(AF) is False
+    assert reg.counter("dht_maintenance_sweeps_total").value == sweeps0 + 1
+    # age every bucket past the 10-min rule → refresh finds hit the wire
+    clock["t"] = 100.0 + NODE_EXPIRE_TIME + 1
+    dht.scheduler.sync_time()
+    n_wire0 = len(sent)
+    assert dht._bucket_maintenance(AF) is True
+    assert len(sent) > n_wire0, "refresh find_nodes never hit the wire"
+    assert reg.counter(
+        "dht_maintenance_refresh_sent_total").value > finds0
+
+
+def test_direct_data_persistence_does_not_enroll_unarmed_storage():
+    """A direct _data_persistence call on a listen-created (unarmed)
+    storage republishes once but must NOT enroll the key in the
+    recurring calendar sweep — storage_store owns arming."""
+    clock = {"t": 7000.0}
+    dht, _ = _make_dht(clock=lambda: clock["t"])
+    rng = np.random.default_rng(22)
+    _fill_table(dht, rng, 40)
+    dht.types.register_type(ValueType(7, "long", expiration=3600.0))
+    key = next(k for k in (_rand_hash(rng) for _ in range(256))
+               if not _scalar_republish_decision(dht, k, AF))
+    dht.listen(key, lambda vals, expired: True)
+    st = dht.store[key]
+    v = Value(b"copy", value_id=5)
+    v.type = 7
+    assert dht.storage_store(key, v, clock["t"])
+    assert not st.maintenance_armed
+    clock["t"] = st.maintenance_time + 1
+    dht.scheduler.sync_time()
+    dht._data_persistence(key)                      # explicit one-shot
+    assert not st.maintenance_armed, \
+        "direct _data_persistence permanently enrolled an unarmed storage"
+    reg = telemetry.get_registry()
+    due_after_direct = reg.counter("dht_maintenance_due_keys_total").value
+    # the calendar entry the one-shot added must keep SKIPPING the
+    # unarmed key at every subsequent fire
+    clock["t"] = st.maintenance_time + STORAGE_CALENDAR_QUANTUM
+    dht.scheduler.run()
+    assert reg.counter(
+        "dht_maintenance_due_keys_total").value == due_after_direct
+    assert dht.get_local(key)

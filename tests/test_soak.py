@@ -71,6 +71,60 @@ def _wait(pred, timeout=30.0, step=0.05) -> bool:
     return False
 
 
+def test_scheduler_heap_o1_under_permanent_puts():
+    """Round-10 soak guard: with the calendar-binned storage sweep,
+    the scheduler heap must stay O(1) in the stored-key count — 10k
+    puts may not cost 10k+ per-key republish/expiry heap entries (the
+    pre-round-10 behavior).  Uses the PR-3 stale-entry gauge to assert
+    lazy-deletion debt stays bounded too."""
+    import socket as _socket
+
+    from opendht_tpu import telemetry
+    from opendht_tpu.runtime import Config, Dht
+    from opendht_tpu.runtime.dht import STORAGE_CALENDAR_QUANTUM
+    from opendht_tpu.scheduler import Scheduler
+    from opendht_tpu.sockaddr import SockAddr
+
+    clock = {"t": 10_000.0}
+    cfg = Config()
+    cfg.maintain_storage = True
+    dht = Dht(lambda data, addr: 0, config=cfg,
+              scheduler=Scheduler(clock=lambda: clock["t"]), has_v6=False)
+    rng = np.random.default_rng(77)
+    table = dht.tables[_socket.AF_INET]
+    added = 0
+    while added < 24:
+        h = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+        if table.insert(h, SockAddr("10.1.0.%d" % (added + 1), 4500),
+                        now=clock["t"], confirm=2) is not None:
+            added += 1
+
+    n_keys = 10_000
+    base = len(dht.scheduler._heap)
+    for i in range(n_keys):
+        assert dht.storage_store(InfoHash.get(f"perm-{i}"),
+                                 Value(b"soak", value_id=1), clock["t"])
+    grown = len(dht.scheduler._heap) - base
+    # every key stored this tick shares ONE expiry bin and ONE
+    # republish bin — the heap growth is bins, not keys
+    assert grown <= 8, \
+        f"{grown} heap entries for {n_keys} stored keys — per-key jobs?"
+    assert len(dht.store) == n_keys
+
+    # drive several republish horizons; the heap must stay bounded by
+    # occupied calendar bins while every key keeps cycling
+    peak = 0
+    for _ in range(3):
+        clock["t"] += 600.0 + STORAGE_CALENDAR_QUANTUM
+        dht.scheduler.run()
+        peak = max(peak, len(dht.scheduler._heap))
+    assert peak < base + 200, \
+        f"heap peaked at {peak} across republish horizons"
+    stale = telemetry.get_registry().gauge(
+        "dht_scheduler_stale_entries").value
+    assert stale < 1000, f"stale-entry debt grew to {stale}"
+
+
 def test_soak_cluster_resources():
     runners = []
 

@@ -374,6 +374,10 @@ def test_scanner_topology_snapshot():
         assert snap["routing"]["ipv4"]["good"] >= 0
         assert "keys" in snap["storage"]
         assert isinstance(snap["events"], list)
+        # round-10 maintenance stats ride the snapshot for soak-diffing
+        assert isinstance(snap["maintenance"], dict)
+        assert all(k.startswith("dht_maintenance_")
+                   for k in snap["maintenance"])
     finally:
         net.close()
 
