@@ -20,8 +20,8 @@ import sys
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(_HERE))
-sys.path.insert(0, _HERE)          # churn_fixtures, when loaded by path
+sys.path.insert(0, _HERE)          # churn_fixtures + driver_common
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 
 def main(argv=None) -> int:

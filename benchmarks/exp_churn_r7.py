@@ -58,8 +58,8 @@ import sys
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(_HERE))
-sys.path.insert(0, _HERE)          # churn_fixtures, when loaded by path
+sys.path.insert(0, _HERE)          # churn_fixtures + driver_common
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 VARIANTS = ("packed", "unpacked", "no_merge", "no_rebuild")
 
@@ -259,13 +259,7 @@ def main(argv=None) -> int:
                 "packing_saves_ms field records the measured value).  "
                 "Settle it with the two commands in this driver's "
                 "docstring on an accelerator session.")
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "captures",
-            args.capture + ".json")
-        with open(path, "w") as f:
-            json.dump(out, f, indent=1)
-            f.write("\n")
-        print(f"capture written: {path}")
+        dc.write_capture(args.capture, out)
     return 0
 
 

@@ -55,7 +55,8 @@ import time
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)          # driver_common
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 
 def _on_node(runner, fn, timeout=20.0):
@@ -331,12 +332,7 @@ def main(argv=None) -> int:
                 "amortizes exists only in TPU tiled layout.  Settle it "
                 "with the two commands in this driver's docstring on an "
                 "accelerator session.")
-        path = os.path.join(os.path.dirname(_HERE), "captures",
-                            args.capture + ".json")
-        with open(path, "w") as f:
-            json.dump(out, f, indent=1)
-            f.write("\n")
-        print(f"capture written: {path}")
+        dc.write_capture(args.capture, out)
     return 0
 
 

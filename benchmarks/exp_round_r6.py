@@ -49,7 +49,8 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 VARIANTS = ("fused", "r5_unfused", "no_reply_gather", "no_lut_reads",
             "no_dedup_sort", "no_alpha_select")
@@ -269,7 +270,7 @@ def main(argv=None) -> int:
                "r5_unfused_ms_per_round": round(dt_u * 1e3 / ROUNDS, 3),
                "samples_ms": [round(d * 1e3, 2) for d in dts_f + dts_u],
                "bit_identical": True}
-        print(json.dumps(rec), flush=True)
+        dc.emit(rec)
         if dt_f > 1.5 * dt_u:
             print(f"SMOKE FAIL: fused round {dt_f * 1e3:.2f} ms > "
                   f"1.5x unfused {dt_u * 1e3:.2f} ms (min of 2 each)")
@@ -327,13 +328,7 @@ def main(argv=None) -> int:
             "variants": recs,
             "bound": bound,
         }
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "captures",
-            args.capture + ".json")
-        with open(path, "w") as f:
-            json.dump(out, f, indent=1)
-            f.write("\n")
-        print(f"capture written: {path}")
+        dc.write_capture(args.capture, out)
     return 0
 
 

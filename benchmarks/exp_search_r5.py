@@ -15,7 +15,8 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 
 def main(argv=None) -> int:

@@ -24,14 +24,14 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 
 def main(argv=None) -> int:
@@ -102,16 +102,10 @@ def main(argv=None) -> int:
         "note": "median 8192-wave search round, telemetry enabled vs "
                 "disabled (host-side envelope only; same executable)",
     }
-    print(json.dumps(rec), flush=True)
+    dc.emit(rec)
 
     if args.save:
-        cap_dir = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "captures")
-        os.makedirs(cap_dir, exist_ok=True)
-        with open(os.path.join(cap_dir, "telemetry_overhead.json"),
-                  "w") as f:
-            json.dump(rec, f, indent=1)
-        print("saved captures/telemetry_overhead.json")
+        dc.write_capture("telemetry_overhead", rec)
 
     if args.smoke and overhead_pct >= 10.0:
         print("telemetry overhead %.2f%% exceeds the 10%% smoke band"

@@ -33,14 +33,14 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
 
 
 def main(argv=None) -> int:
@@ -157,15 +157,10 @@ def main(argv=None) -> int:
                 "tracer disabled (host-side envelope only; same "
                 "executable; telemetry on in all modes)",
     }
-    print(json.dumps(rec), flush=True)
+    dc.emit(rec)
 
     if args.save:
-        cap_dir = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "captures")
-        os.makedirs(cap_dir, exist_ok=True)
-        with open(os.path.join(cap_dir, "trace_overhead.json"), "w") as f:
-            json.dump(rec, f, indent=1)
-        print("saved captures/trace_overhead.json")
+        dc.write_capture("trace_overhead", rec)
 
     if args.smoke and on_pct >= 10.0:
         print("trace overhead %.2f%% exceeds the 10%% smoke band"

@@ -219,10 +219,64 @@ def check_config_captures(failures):
     return checked
 
 
+def check_trajectory(failures):
+    """The BENCH trajectory, enforced BOTH directions (ISSUE-6
+    satellite): the committed PERF_TRAJECTORY.json must equal a fresh
+    assembly of its sources (BENCH_r*.json / captures / TP_SCALING.json
+    — ci/assemble_trajectory.py build()), and README's
+    ``<!-- trajectory -->``-tagged table must quote every round's
+    vs-baseline figure within 2% — a new BENCH round can't stay
+    invisible, and a README claim can't outlive its artifact."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "assemble_trajectory",
+        os.path.join(ROOT, "ci", "assemble_trajectory.py"))
+    asm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(asm)
+    traj_path = os.path.join(ROOT, "PERF_TRAJECTORY.json")
+    msg = asm.drift()
+    if msg:
+        failures.append(msg)
+        if not os.path.exists(traj_path):
+            return
+    with open(traj_path) as f:
+        committed = json.load(f)
+    readme = os.path.join(ROOT, "README.md")
+    if not os.path.exists(readme):
+        return
+    lines = open(readme).read().splitlines()
+    tagged = [i for i, ln in enumerate(lines) if "<!-- trajectory -->" in ln]
+    if not tagged:
+        failures.append("README.md: no '<!-- trajectory -->'-tagged table "
+                        "quoting PERF_TRAJECTORY.json")
+        return
+    quoted = []
+    for li in tagged:
+        lo = li
+        while lo > 0 and lines[lo - 1].strip():
+            lo -= 1
+        hi = li
+        while hi + 1 < len(lines) and lines[hi + 1].strip():
+            hi += 1
+        para = " ".join(lines[lo:hi + 1])
+        quoted += [float(v) for v in
+                   re.findall(r"(\d+(?:\.\d+)?)[x×]", para)]
+    for r in committed.get("rounds", []):
+        v = r.get("vs_baseline")
+        if not v:
+            continue
+        if not any(abs(q - v) <= 0.02 * v + 0.5 for q in quoted):
+            failures.append(
+                f"README.md: trajectory table quotes no "
+                f"{v}x-vs-baseline figure for round {r['round']} "
+                f"({r['source']})")
+
+
 def main() -> int:
     failures = []
     cap = check_headline(failures)
     checked = check_config_captures(failures)
+    check_trajectory(failures)
     if failures:
         print("DOCS DRIFT from capture artifacts:")
         for fmsg in failures:

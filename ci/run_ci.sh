@@ -4,6 +4,11 @@
 # driver entry checks and a CPU-scaled bench smoke.
 set -e
 cd "$(dirname "$0")/.."
+# smoke drivers drop their JSON records here (benchmarks/driver_common.py
+# emit); the perf gate at the end of this script soft-checks the timing
+# ceilings in perf_budgets.json against them
+export OPENDHT_TPU_SMOKE_RECORD_DIR="$(mktemp -d /tmp/odt-smoke.XXXXXX)"
+trap 'rm -rf "$OPENDHT_TPU_SMOKE_RECORD_DIR"' EXIT
 # packaging smoke: the wheel must build and every console entry point
 # must resolve (catches pyproject drift before the Docker tier does)
 python -m pip wheel --no-build-isolation --no-deps -q -w /tmp/odt-ci-wheel .
@@ -15,7 +20,10 @@ print("entry points ok")
 PY
 python -m pytest tests/ -q
 # README/PARITY headline quotes must agree with the last accelerator
-# bench capture (within the stated cross-run drift band)
+# bench capture (within the stated cross-run drift band), and the
+# committed PERF_TRAJECTORY.json must equal a fresh assembly of its
+# sources (BENCH_r* / captures / TP_SCALING) with the README trajectory
+# table quoting it — both directions
 python ci/check_docs.py
 python - <<'PY'
 import os
@@ -125,6 +133,49 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "tracing overhead smoke failed"
 PY
+# round-fused stage-profile smoke (round 11): the per-stage chain-slope
+# decomposition mirroring the ROUND-6 fused round body must run end to
+# end at a small shape (a stage-level compile break or an
+# order-of-magnitude wave stall fails here without the full bench)
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "profile_search", pathlib.Path("benchmarks/profile_search.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "profile_search smoke failed"
+PY
+# kernel-ledger overhead smoke (round 11): with the cost ledger computed
+# and the wave_attrs hook live on the traced record_wave path, the wave
+# must stay inside a generous 5% band vs the ledger-disabled run (the
+# committed captures/ledger_overhead.json documents the tight number,
+# enforced against the README quote by check_docs above)
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_ledger_r11", pathlib.Path("benchmarks/exp_ledger_r11.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "ledger overhead smoke failed"
+PY
+# kernel-ledger export smoke (round 11): boot a node + proxy, compute a
+# ledger subset, scrape GET /stats and get_metrics(), assert the
+# dht_kernel_* series are present, agree, and the exposition parses
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.ledger_smoke import main
+rc = main()
+assert rc == 0, "ledger smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and
@@ -161,3 +212,12 @@ spec.loader.exec_module(m)
 assert len(jax.devices()) == 8
 m.main(["-c", "3", "--tp", "-N", "65536", "-Q", "1024"])
 PY
+# kernel cost-model perf gate (round 11, ROADMAP item 3): every shipped
+# kernel's lowered XLA cost model (flops / bytes accessed / arg+output
+# bytes at its canonical shape) must sit inside the committed
+# perf_budgets.json tolerances — DETERMINISTIC on the CPU runner, so a
+# refactor that doubles a kernel's HBM traffic fails CI here with a
+# budget-vs-observed diff.  Wall-clock stays advisory: the smoke records
+# collected above are checked against the timing_soft ceilings as
+# warnings only (shared runners flake; cost gates, timing informs).
+python ci/perf_gate.py --records "$OPENDHT_TPU_SMOKE_RECORD_DIR"

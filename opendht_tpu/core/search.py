@@ -735,9 +735,18 @@ def record_wave(out, elapsed_s: float, wave_width: int, *,
     if tr.enabled and ctx is not None:
         end = time.time()
         start = end - elapsed_s
+        # ISSUE-6: device-cost attribution from the kernel ledger — the
+        # scaled cost-model estimate (bytes/flops) and the achieved HBM
+        # fraction ride the wave span, so a Perfetto load shows which
+        # waves ran memory-bound and how far from peak.  Empty dict (one
+        # cached-flag check) until someone computes the ledger; cost
+        # quantified by captures/ledger_overhead.json.
+        from .. import profiling
+        cost = profiling.wave_attrs(int(wave_width), rounds, elapsed_s,
+                                    mode=mode)
         wave_ctx = tr.record("dht.search.wave", start, elapsed_s,
                              parent=ctx, mode=mode,
-                             width=int(wave_width), rounds=rounds)
+                             width=int(wave_width), rounds=rounds, **cost)
         if wave_ctx is not None and 0 < rounds <= _TRACE_MAX_ROUND_SPANS:
             per_round = elapsed_s / rounds
             for i in range(rounds):

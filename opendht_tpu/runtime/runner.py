@@ -764,6 +764,15 @@ class DhtRunner:
                     continue
                 for field, v in st.to_dict().items():
                     reg.gauge("dht_routing_" + field, family=fam).set(v)
+        # kernel cost ledger (ISSUE-6): publish dht_kernel_* gauges when
+        # the ledger has been computed (REPL `kernels`, scanner, CI) or
+        # OPENDHT_TPU_LEDGER=1 arms eager compute — a no-op dict check
+        # otherwise, so a bare scrape stays cheap
+        try:
+            from .. import profiling
+            profiling.maybe_export(reg)
+        except Exception:
+            pass
         return reg.snapshot()
 
     def get_trace(self, trace_id) -> list:

@@ -1,0 +1,100 @@
+"""End-to-end kernel-cost-ledger smoke (ISSUE-6 CI satellite).
+
+Boots one node + its REST proxy, computes a subset of the kernel cost
+ledger (opendht_tpu/profiling.py — the subset keeps the CI step in
+seconds; ci/perf_gate.py lowers the FULL set in the same run), then
+asserts the ledger actually reaches both export surfaces the spine
+serves:
+
+1. ``DhtRunner.get_metrics()`` carries ``dht_kernel_*`` gauges with
+   the lowered cost-model values;
+2. the proxy's ``GET /stats`` Prometheus exposition carries the same
+   series and still parses line-by-line against the v0.0.4 grammar
+   (reusing telemetry_smoke's validator);
+3. the two exports agree on the values (one registry, two views).
+
+Run directly (CI does)::
+
+    python -m opendht_tpu.testing.ledger_smoke
+"""
+
+from __future__ import annotations
+
+import sys
+import urllib.request
+
+from ..runtime.runner import DhtRunner
+from .telemetry_smoke import parse_exposition
+
+#: lowered in the smoke — small, fast, and covering one kernel from
+#: each family (window lookup / gather / maintenance)
+SMOKE_KERNELS = ["expanded_topk", "fused_gather_planar",
+                 "maintenance_sweep"]
+
+
+def main(argv=None) -> int:
+    from .. import profiling
+    from ..proxy import DhtProxyServer
+
+    node = DhtRunner()
+    proxy = None
+    try:
+        node.run(0)
+        led = profiling.get_ledger()
+        entries = led.compute(SMOKE_KERNELS)
+        bad = {n: e["error"] for n, e in entries.items() if "error" in e}
+        if bad:
+            print("ledger_smoke: kernels failed to lower: %s" % bad,
+                  file=sys.stderr)
+            return 1
+        led.export_to_registry()
+
+        # surface 1: get_metrics JSON
+        metrics = node.get_metrics()
+        gauges = metrics.get("gauges", {})
+        for name in SMOKE_KERNELS:
+            key = 'dht_kernel_bytes_accessed{kernel="%s"}' % name
+            if key not in gauges:
+                print("ledger_smoke: %s missing from get_metrics()" % key,
+                      file=sys.stderr)
+                return 1
+            if gauges[key] != entries[name]["bytes_accessed"]:
+                print("ledger_smoke: %s = %r disagrees with the ledger "
+                      "entry %r" % (key, gauges[key],
+                                    entries[name]["bytes_accessed"]),
+                      file=sys.stderr)
+                return 1
+
+        # surface 2: the proxy's Prometheus exposition
+        proxy = DhtProxyServer(node, 0)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/stats" % proxy.port, timeout=10.0) as r:
+            text = r.read().decode()
+        series = parse_exposition(text)         # raises on grammar errors
+        for name in SMOKE_KERNELS:
+            for fam in ("dht_kernel_flops", "dht_kernel_bytes_accessed",
+                        "dht_kernel_hbm_bytes"):
+                key = '%s{kernel="%s"}' % (fam, name)
+                if key not in series:
+                    print("ledger_smoke: %s missing from GET /stats"
+                          % key, file=sys.stderr)
+                    return 1
+                if series[key] != float(
+                        entries[name][fam.replace("dht_kernel_", "")]):
+                    print("ledger_smoke: /stats %s disagrees with the "
+                          "ledger" % key, file=sys.stderr)
+                    return 1
+        print("ledger_smoke ok: %d kernels exported, %d exposition "
+              "series parsed" % (len(SMOKE_KERNELS), len(series)))
+        return 0
+    finally:
+        if proxy is not None:
+            try:
+                proxy.stop()
+            except Exception:
+                pass
+        node.join()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,13 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
     ii <name> <key>        index: lookup
     stats [prom]           unified telemetry (JSON snapshot; 'prom' =
                            Prometheus text, same registry as GET /stats)
+    kernels [measure]      kernel cost ledger: per-kernel XLA cost model
+                           (flops / bytes accessed / HBM footprint) at
+                           the canonical shapes ci/perf_gate.py budgets;
+                           'measure' adds one timed canonical launch per
+                           kernel + roofline attribution vs the platform
+                           peaks.  Exports dht_kernel_* gauges to the
+                           same registry GET /stats serves
     trace [id|chrome [f]]  distributed tracing: no arg = recent trace
                            ids in the ring; '<trace id>' = that trace's
                            span tree; 'chrome [file]' = Perfetto/Chrome
@@ -115,6 +122,40 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                     import json as _json
                     print(_json.dumps(node.get_metrics(), indent=2,
                                       sort_keys=True))
+            elif op == "kernels":
+                # kernel cost ledger (ISSUE-6): lowers each shipped
+                # kernel at its canonical shape on first use (seconds),
+                # cached for the process; 'measure' adds a timed launch
+                # + roofline % of platform peak
+                from .. import profiling
+                led = profiling.get_ledger()
+                if rest and rest[0] == "measure":
+                    led.measure()
+                else:
+                    led.compute()
+                led.export_to_registry()
+                entries = led.snapshot()
+                print("%-28s %s" % ("kernel",
+                                    "  MFLOP  MB-accessed  MB-hbm"))
+                for name in sorted(entries):
+                    e = entries[name]
+                    if "error" in e:
+                        print("%-28s ERROR %s" % (name, e["error"]))
+                        continue
+                    line = "%-28s %7.2f %12.2f %7.2f" % (
+                        name, e["flops"] / 1e6,
+                        e["bytes_accessed"] / 1e6, e["hbm_bytes"] / 1e6)
+                    if "live_p50_s" in e:
+                        line += "  live p50 %.3f ms (n=%d)" % (
+                            e["live_p50_s"] * 1e3, e["live_count"])
+                    rl = e.get("roofline")
+                    if rl:
+                        line += "  %.3f ms -> %s-bound, %.1f%% HBM peak" \
+                            % (e["measured_s"] * 1e3, rl["bound"],
+                               rl["hbm_pct_of_peak"])
+                    print(line)
+                print("%d kernels; budgets gated by ci/perf_gate.py "
+                      "(perf_budgets.json)" % len(entries))
             elif op == "trace":
                 import json as _json
                 from .. import tracing

@@ -60,8 +60,19 @@ def topology_snapshot(node) -> dict:
         "storage": {},
         "metrics_gauges": {},
         "maintenance": {},
+        "kernels": {},
         "events": [],
     }
+    try:
+        # kernel cost ledger (ISSUE-6): report whatever is already
+        # computed — the snapshot must stay cheap enough for every soak
+        # tick, so it never triggers the (seconds-long) lowering itself;
+        # `dhtscanner --kernels` / the REPL `kernels` cmd arm it
+        from .. import profiling
+        if profiling.ledger_computed():
+            snap["kernels"] = profiling.get_ledger().snapshot()
+    except Exception:
+        pass
     try:
         metrics = node.get_metrics()
         snap["metrics_gauges"] = {
@@ -114,7 +125,16 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="emit one JSON document (topology snapshot + "
                         "discovered peers) instead of human output")
+    p.add_argument("--kernels", action="store_true",
+                   help="compute the kernel cost ledger (seconds of "
+                        "one-time lowering) so the snapshot's 'kernels' "
+                        "section carries per-kernel flops/bytes/HBM "
+                        "footprint")
     args = p.parse_args(argv)
+    if args.kernels:
+        from .. import profiling
+        profiling.get_ledger().compute()
+        profiling.maybe_export()
     node = setup_node(args)
     if not args.json:
         print_node_info(node)
