@@ -1,0 +1,158 @@
+"""Continuous-batching ingest attribution (round-12 tentpole,
+runtime/wave_builder.py): per-op amortization of coalesced vs per-op
+dispatch, measured on the LIVE lookup path.
+
+Before round 12 every live get/put/listen resolved its search refill
+through ``find_closest_nodes_batched([one target])`` — one device
+launch per op, padded to the full lane width, plus the per-launch host
+scatter (row→Node conversion).  The wave builder coalesces a pump's
+worth of refills into one ``[Q]`` launch.  This driver measures exactly
+that trade on CPU, through the SHIPPING ``Dht.find_closest_nodes_batched``
+entry point (device launch + host scatter, the whole per-op cost the
+builder amortizes):
+
+  per_op       Q separate [1]-target resolves (the batching="off"
+               dispatch), wall per op
+  coalesced    ONE [Q]-target resolve (the wave the builder launches
+               at its fill target), wall per op
+  amortization per_op / coalesced
+
+``--capture ingest_wave`` writes captures/ingest_wave.json; README
+quotes the amortization and both per-op figures under
+``<!-- capture:ingest_wave -->`` (ci/check_docs.py enforces the quotes
+both directions).  The on-chip occupancy/latency number is OPEN —
+the 128-lane padding tax this amortizes is a TPU tiled-layout effect,
+so the CPU figure under-states it.  Settle on an accelerator session:
+
+  python benchmarks/exp_ingest_r12.py --capture ingest_wave
+  python -m opendht_tpu.testing.ingest_smoke
+
+(the fourth OPEN entry in perf_budgets.json, ``ingest_wave_occupancy``.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)          # driver_common
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
+
+
+def _build_dht(n: int, n_targets: int, seed: int = 31):
+    """A v4-only Dht over a swallow-everything transport with an
+    n-row bulk-loaded, addr-servable table — the live resolve's exact
+    substrate."""
+    from opendht_tpu.infohash import InfoHash
+    from opendht_tpu.runtime import Config, Dht
+    from opendht_tpu.scheduler import Scheduler
+    from opendht_tpu.sockaddr import SockAddr
+
+    clock = {"t": 1000.0}
+    dht = Dht(lambda data, addr: 0, config=Config(),
+              scheduler=Scheduler(clock=lambda: clock["t"]), has_v6=False)
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 2 ** 32, size=(n, 5), dtype=np.uint32)
+    dht.tables[next(iter(dht.tables))].bulk_load(
+        ids, now=clock["t"], addrs=SockAddr("10.7.0.1", 4222))
+    targets = [InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+               for _ in range(n_targets)]
+    return dht, targets
+
+
+def _measure(dht, targets, q: int, k: int, reps: int):
+    """Median wall seconds per op for the per-op and coalesced forms
+    over ``reps`` disjoint Q-target waves each."""
+    import socket as _socket
+    af = _socket.AF_INET
+
+    # warm both compiled shapes out of the measurement
+    dht.find_closest_nodes_batched(targets[:1], af, k)
+    dht.find_closest_nodes_batched(targets[:q], af, k)
+
+    per_op, coalesced = [], []
+    for r in range(reps):
+        wave = targets[r * q:(r + 1) * q]       # disjoint per rep
+        assert len(wave) == q
+        t0 = time.perf_counter()
+        for t in wave:
+            dht.find_closest_nodes_batched([t], af, k)
+        per_op.append((time.perf_counter() - t0) / q)
+        t0 = time.perf_counter()
+        out = dht.find_closest_nodes_batched(wave, af, k)
+        coalesced.append((time.perf_counter() - t0) / q)
+        assert len(out) == q
+    return float(np.median(per_op)), float(np.median(coalesced))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-N", type=int, default=65536, help="table rows")
+    p.add_argument("-Q", type=int, default=64,
+                   help="wave width (the fill target)")
+    p.add_argument("-k", type=int, default=14,
+                   help="refill k (live_search.SEARCH_NODES)")
+    p.add_argument("--reps", type=int, default=9)
+    p.add_argument("--capture", default="",
+                   help="write captures/<name>.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="small-shape CI form: assert coalescing still "
+                        "amortizes (>2x) without the full shape")
+    args = p.parse_args(argv)
+
+    import jax
+
+    n, q, reps = ((8192, 16, 5) if args.smoke
+                  else (args.N, args.Q, args.reps))
+    dht, targets = _build_dht(n, n_targets=q * reps)
+    per_op_s, coalesced_s, = _measure(dht, targets, q, args.k, reps)
+    amort = per_op_s / coalesced_s if coalesced_s > 0 else float("inf")
+
+    rec = dc.emit({
+        "driver": "exp_ingest_r12",
+        "N": n, "Q": q, "k": args.k,
+        "per_op_us": round(per_op_s * 1e6, 2),
+        "coalesced_us_per_op": round(coalesced_s * 1e6, 2),
+        "ingest_amortization_x": round(amort, 1),
+        "platform": jax.default_backend(),
+    })
+
+    if args.smoke:
+        assert amort > 2.0, (
+            "coalesced dispatch no longer amortizes: %.2fx" % amort)
+        print("ingest amortization smoke ok: %.1fx" % amort)
+        return 0
+
+    if args.capture:
+        dc.write_capture(args.capture, {
+            "metric": ("continuous-batching ingest, live resolve path: "
+                       "Q separate [1]-target find_closest_nodes_batched "
+                       "dispatches (the batching=off per-op path) vs ONE "
+                       "[Q]-target wave (the builder's fill-target "
+                       "launch), device launch + host scatter included, "
+                       "platform=cpu; value = per-op amortization factor"),
+            "value": round(amort, 1),
+            "unit": "x per-op amortization (cpu)",
+            "bound": {
+                "N": n, "Q": q, "k": args.k,
+                "per_op_us": rec["per_op_us"],
+                "coalesced_us_per_op": rec["coalesced_us_per_op"],
+                "ingest_amortization_x": round(amort, 1),
+            },
+            "accelerator_target": (
+                "the on-chip occupancy/latency number is OPEN "
+                "(perf_budgets.json ingest_wave_occupancy): cpu has no "
+                "128-lane padding tax, so this amortization under-states "
+                "the TPU figure.  Settle with the two commands in this "
+                "driver's docstring on an accelerator session."),
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,6 +195,28 @@ def check_config_captures(failures):
                                 failures.append(
                                     f"{doc}: [{tag}] quotes {q} ms vs "
                                     f"captured {field}={w} (±15%)")
+                # round-12 ingest attribution: the per-op amortization
+                # factor and both per-op µs figures quoted in the docs
+                # must track captures/ingest_wave.json
+                if "ingest_amortization_x" in bound:
+                    for q in re.findall(
+                            r"(\d+(?:\.\d+)?)× per-op amortization", para):
+                        w = bound["ingest_amortization_x"]
+                        if not (0.85 * w <= float(q) <= 1.15 * w):
+                            failures.append(
+                                f"{doc}: [{tag}] quotes {q}x per-op "
+                                f"amortization vs captured {w} (±15%)")
+                    for pat, field in (
+                            (r"(\d+(?:\.\d+)?) ?µs/op per-op",
+                             "per_op_us"),
+                            (r"(\d+(?:\.\d+)?) ?µs/op coalesced",
+                             "coalesced_us_per_op")):
+                        for q in re.findall(pat, para):
+                            w = bound[field]
+                            if not (0.85 * w <= float(q) <= 1.15 * w):
+                                failures.append(
+                                    f"{doc}: [{tag}] quotes {q} µs/op vs "
+                                    f"captured {field}={w} (±15%)")
                 if cap.get("unit") == "percent":
                     def _pct_band(quoted, captured, what):
                         tol = max(1.0, 0.5 * abs(captured))

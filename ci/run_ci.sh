@@ -176,6 +176,37 @@ from opendht_tpu.testing.ledger_smoke import main
 rc = main()
 assert rc == 0, "ledger smoke failed"
 PY
+# ingest-amortization smoke (round 12): the coalesced [Q] resolve must
+# still amortize the per-op dispatch (>2x at a small shape) through the
+# SHIPPING find_closest_nodes_batched stack — a refactor that sneaks a
+# per-target dispatch back into the wave path fails here without the
+# full bench.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_ingest_r12", pathlib.Path("benchmarks/exp_ingest_r12.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "ingest amortization smoke failed"
+PY
+# burst-ingest smoke (round 12): boot a real-UDP cluster + proxy, fire
+# concurrent gets/puts/listens from threads, assert the wave builder
+# actually coalesced them (mean wave occupancy > 1 on the new
+# histogram, dht_ingest_* series on the proxy /stats exposition, zero
+# sheds), and that the identical workload rerun with
+# ingest_batching="off" returns the same values and leaves the same
+# per-node storage state — the acceptance-criteria equivalence pin.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.ingest_smoke import main
+rc = main()
+assert rc == 0, "ingest smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

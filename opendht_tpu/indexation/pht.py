@@ -22,6 +22,7 @@ include/opendht/indexation/pht.h:49-533, src/indexation/pht.cpp):
 
 from __future__ import annotations
 
+import logging
 import random
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -29,6 +30,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..infohash import InfoHash
 from ..core.value import Value
 from ..utils import pack_msg, unpack_msg
+
+log = logging.getLogger("opendht_tpu.pht")
 
 MAX_NODE_ENTRY_COUNT = 16          # pht.h:297
 CACHE_MAX_ELEMENT = 1024           # pht.h:383
@@ -570,13 +573,34 @@ class Pht:
                                   "max_common": None},
                                  time_p, False, None)
                     tok = token_box.get("token")
-                    if tok is not None:
+                    if tok:
                         self.dht.cancel_listen(next_prefix.hash(), tok)
                     return False
             return True
 
-        token_box["token"] = self.dht.listen(next_prefix.hash(), on_values,
-                                             self._pht_filter)
+        tok = self.dht.listen(next_prefix.hash(), on_values,
+                              self._pht_filter)
+
+        def record(t) -> None:
+            # no live subscription: None = shed at ingest admission
+            # (round 12 backpressure), 0 = the callback consumed local
+            # values and stopped.  Either way the insert itself already
+            # completed — only the split watch degrades, so record
+            # nothing rather than a bogus token
+            if t:
+                token_box["token"] = t
+            else:
+                log.debug("pht: no split-watch subscription for %s (%s)",
+                          next_prefix.to_string(),
+                          "shed" if t is None else "satisfied locally")
+
+        if hasattr(tok, "add_done_callback"):
+            # DhtRunner backend: listen returns a Future resolving to
+            # the runner token (0 = shed) — never block the insert path
+            tok.add_done_callback(
+                lambda f: record(0 if f.exception() else f.result()))
+        else:
+            record(tok)
 
     @staticmethod
     def find_split_location(compared: Prefix,

@@ -139,6 +139,8 @@ _CANON = {
     "GATHER_M": 2048,   # fused-gather row-vector width
     "R": 24,            # alpha*k reply rows per query (alpha=3)
     "W": 256,           # simulate_lookups wave width
+    "INGEST_Q": 64,     # wave-builder fill target (config.ingest_fill_target)
+    "INGEST_K": 14,     # refill k (live_search.SEARCH_NODES)
 }
 
 
@@ -175,6 +177,27 @@ def _spec_find_closest():
         return lookup_topk(s, nv, q, k=_CANON["K"], lut=lut, expanded=e)
     return (jax.jit(fn), (s, e, nv, q, lut), {},
             {"N": _CANON["N"], "Q": _CANON["Q"], "k": _CANON["K"]})
+
+
+def _spec_wave_builder():
+    """The ingest wave builder's canonical coalesced launch (round 12,
+    runtime/wave_builder.py): ``lookup_topk`` at the fill target
+    Q=64 refill targets × k=SEARCH_NODES=14 — the [Q] wave a fully
+    coalesced pump of live get/put/listen refills dispatches, vs the
+    Q=1 padded launch each op used to pay.  Budgeted from day one so a
+    refactor can't silently fatten the new hot path's device program
+    (the ISSUE-7 tentpole's cost-gate requirement)."""
+    import jax
+    from .ops.sorted_table import lookup_topk
+    s, e, nv, lut = _canonical_table(_CANON["N"])
+    q = _queries(_CANON["INGEST_Q"], seed=24)
+
+    def fn(s, e, nv, q, lut):
+        return lookup_topk(s, nv, q, k=_CANON["INGEST_K"], lut=lut,
+                           expanded=e)
+    return (jax.jit(fn), (s, e, nv, q, lut), {},
+            {"N": _CANON["N"], "Q": _CANON["INGEST_Q"],
+             "k": _CANON["INGEST_K"]})
 
 
 def _spec_expanded_topk():
@@ -332,6 +355,7 @@ def _spec_sharded_maintenance():
 #: kernel, so exports can put the live p50 next to the canonical cost.
 KERNEL_SPECS = {
     "find_closest_nodes_batched": (_spec_find_closest, None),
+    "wave_builder_lookup": (_spec_wave_builder, "dht_ingest_wave_seconds"),
     "expanded_topk": (_spec_expanded_topk, None),
     "fused_gather_planar": (_spec_fused_gather, None),
     "packed_churn_merge": (_spec_packed_merge, None),

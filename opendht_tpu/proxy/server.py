@@ -31,6 +31,7 @@ thread, responses are close-delimited.
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import queue
 import socket
@@ -195,6 +196,14 @@ class DhtProxyServer:
             info["ipv6"] = r.get_node_stats(_s.AF_INET6).to_dict()
         except Exception:
             info["ipv6"] = {}
+        try:
+            # round-12 ingest surface: the wave builder's coalescing
+            # health next to the routing stats (queue depth, occupancy
+            # percentiles, sheds) — the JSON sibling of the
+            # dht_ingest_* series GET /stats exports
+            info["ingest"] = r._dht.wave_builder.snapshot()
+        except Exception:
+            info["ingest"] = {}
         return info
 
     def _maintenance_loop(self) -> None:
@@ -434,6 +443,22 @@ def _make_handler(server: DhtProxyServer):
                 return True
 
             token_fut = runner.listen(key, cb)
+            # 0 sentinel (round 12): the backend listen was shed at
+            # ingest admission — no subscription exists, so fail the
+            # request instead of streaming heartbeats forever.  Short
+            # wait only: while the node is still bootstrapping the
+            # listen op is legitimately queued (normal-op gating), and
+            # the pre-round-12 behavior — start streaming, subscription
+            # materializes when the node connects — must be preserved.
+            try:
+                if token_fut.result(2.0) == 0:
+                    self._err(503, "listen shed by ingest backpressure")
+                    return
+            except concurrent.futures.TimeoutError:
+                pass                     # still queued: stream as before
+            except Exception:
+                self._err(500, "listen failed")
+                return
             with server._lock:
                 server.stats.listen_count += 1
             self._begin_stream()
@@ -611,6 +636,27 @@ def _make_handler(server: DhtProxyServer):
                 return True
 
             rec.token = runner.listen(key, cb)
+            try:
+                # 0 sentinel (round 12): shed at ingest admission — the
+                # push subscription does not exist; drop the reserved
+                # slot and tell the client instead of returning a token
+                # that will never deliver.  Short wait only: a listen
+                # still queued behind bootstrap gating keeps the
+                # pre-round-12 register-asynchronously behavior.
+                if rec.token.result(2.0) == 0:
+                    with server._lock:
+                        if server._push_listeners.get(
+                                (key, client_id)) is rec:
+                            del server._push_listeners[(key, client_id)]
+                            server.stats.push_listeners_count = \
+                                len(server._push_listeners)
+                    self._err(503, "listen shed by ingest backpressure")
+                    return
+            except concurrent.futures.TimeoutError:
+                pass                     # still queued: register as before
+            except Exception:
+                self._err(500, "listen failed")
+                return
             # a concurrent UNSUBSCRIBE (or expiry sweep) may have removed
             # the record while the backend listen was registering; tear
             # the fresh listener down instead of leaking it

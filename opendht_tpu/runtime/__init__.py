@@ -14,3 +14,4 @@ src/node_cache.cpp:41-74)."""
 
 from .config import Config, NodeStatus, NodeStats, DEFAULT_STORAGE_LIMIT  # noqa: F401
 from .dht import Dht  # noqa: F401
+from .wave_builder import WaveBuilder  # noqa: F401

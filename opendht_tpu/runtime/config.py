@@ -58,6 +58,24 @@ class Config:
     storage_limit: int = DEFAULT_STORAGE_LIMIT
     max_req_per_sec: int = 1600      # ingress budget; per-IP = this // 8
 
+    # --- continuous-batching ingest (round 12, runtime/wave_builder.py) ---
+    #: "on" coalesces live search refills into shared [Q] device
+    #: launches; "off" is the escape hatch pinned result-equivalent to
+    #: the per-op dispatch path (one padded launch per op)
+    ingest_batching: str = "on"
+    #: fill target Q: a wave fires as soon as this many lookups queue
+    ingest_fill_target: int = 64
+    #: deadline knob (seconds): the oldest queued lookup's maximum wait
+    #: before a partial wave fires anyway
+    ingest_deadline: float = 0.002
+    #: admission bound: NEW ops are shed (never in-flight searches)
+    #: once this many lookups are queued
+    ingest_queue_max: int = 4096
+    #: optional op-admission quota (ops/s through rate_limiter.
+    #: RateLimiter, the same sliding window the net engine's ingress
+    #: quotas use); 0 = unlimited
+    ingest_admit_per_sec: int = 0
+
 
 @dataclass
 class SecureDhtConfig:

@@ -53,6 +53,7 @@ from .live_search import (
     SEARCH_EXPIRE_TIME, SEARCH_MAX_BAD_NODES, SEARCH_NODES, Search, SearchNode,
     TARGET_NODES, acked_request, cancelled_request,
 )
+from .wave_builder import WaveBuilder
 
 log = logging.getLogger("opendht_tpu.dht")
 
@@ -173,6 +174,12 @@ class Dht:
         # one scheduler job per OCCUPIED bin replaces the per-key
         # _data_persistence/_expire_storage jobs (see _calendar_add)
         self._storage_calendar: Dict[int, set] = {}
+
+        # continuous-batching ingest (round 12): live search refills
+        # from EVERY traffic source coalesce into shared [Q] device
+        # launches; new ops shed at admission under backpressure
+        # (wave_builder.py; config.ingest_* knobs)
+        self.wave_builder = WaveBuilder(self, config)
 
         # maintenance telemetry (ISSUE-5): handles cached once
         _reg = telemetry.get_registry()
@@ -450,11 +457,30 @@ class Dht:
     def _refill(self, sr: Search) -> int:
         """Seed/refresh the candidate set from the routing table — the
         batched device top-k instead of the reference's scalar cache walk
-        (↔ Dht::refill, src/dht.cpp:656-677)."""
+        (↔ Dht::refill, src/dht.cpp:656-677).
+
+        Round 12: with the ingest wave builder enabled the resolve rides
+        the next shared ``[Q]`` wave (fill- or deadline-triggered)
+        instead of paying a per-search padded launch; the nodes land via
+        :meth:`_refill_apply` and the search re-steps itself.  The
+        ``ingest_batching="off"`` path below is byte-for-byte the
+        pre-round-12 per-op dispatch."""
         now = self.scheduler.time()
         sr.refill_time = now
+        if self.wave_builder.enabled:
+            if not sr.refill_pending:
+                sr.refill_pending = True
+                self.wave_builder.submit(
+                    sr.id, sr.af, SEARCH_NODES,
+                    lambda nodes, _sr=sr: self._refill_apply(_sr, nodes))
+            return 0
+        return self._refill_insert(
+            sr, self.find_closest_nodes(sr.id, sr.af, SEARCH_NODES))
+
+    def _refill_insert(self, sr: Search, nodes: List[Node]) -> int:
+        now = self.scheduler.time()
         inserted = 0
-        for n in self.find_closest_nodes(sr.id, sr.af, SEARCH_NODES):
+        for n in nodes:
             if sr.insert_node(n, now):
                 inserted += 1
         # fall back to the engine's interned-node cache when the table is
@@ -464,6 +490,16 @@ class Dht:
                 if sr.insert_node(n, now):
                     inserted += 1
         return inserted
+
+    def _refill_apply(self, sr: Search, nodes: List[Node]) -> None:
+        """Scatter half of a coalesced refill: the wave that carried
+        this search's resolve delivers its candidate rows; step the
+        search at whatever round it is on (continuous batching — a
+        search never blocks a wave, a wave never blocks a search)."""
+        sr.refill_pending = False
+        self._refill_insert(sr, nodes)
+        if not sr.expired and not sr.done:
+            self._edit_step(sr, self.scheduler.time())
 
     def _search_step(self, sr: Search) -> None:
         """One scheduler-driven step (↔ Dht::searchStep,
@@ -510,7 +546,12 @@ class Dht:
             if self._search_send_get_values(sr) is None:
                 break
 
-        if sr.get_number_of_consecutive_bad_nodes() >= min(
+        # a refill in flight on the wave builder must finish before the
+        # bad-node rule can expire the search: a freshly-admitted op's
+        # candidate set is legitimately empty until its wave lands
+        # (0 >= min(0, MAX) would expire it within one step otherwise)
+        if not sr.refill_pending and \
+                sr.get_number_of_consecutive_bad_nodes() >= min(
                 len(sr.nodes), SEARCH_MAX_BAD_NODES):
             log.warning("[search %s] expired", sr.id,
                         extra={"dht_hash": bytes(sr.id)})
@@ -844,6 +885,10 @@ class Dht:
             f: Optional[Filter] = None, where: Optional[Where] = None) -> None:
         """Iterative value lookup over both families
         (↔ Dht::get, src/dht.cpp:980-1017)."""
+        if not self.wave_builder.admit("get"):
+            if done_cb:
+                done_cb(False, [])
+            return
         log.debug("[search %s] get", key, extra={"dht_hash": bytes(key)})
         q = Query(Select(), where or Where())
         f = Filters.chain(f, q.where.get_filter())
@@ -904,6 +949,10 @@ class Dht:
     def query(self, key: InfoHash, query_cb, done_cb=None,
               q: Optional[Query] = None) -> None:
         """Remote field query (↔ Dht::query, src/dht.cpp:1019-1064)."""
+        if not self.wave_builder.admit("query"):
+            if done_cb:
+                done_cb(False, [])
+            return
         q = q or Query()
         f = q.where.get_filter()
         state = {"done": False, "done4": False, "done6": False,
@@ -953,6 +1002,10 @@ class Dht:
             created: Optional[float] = None, permanent: bool = False) -> None:
         """Store a value on the k closest nodes
         (↔ Dht::put, src/dht.cpp:913-946)."""
+        if not self.wave_builder.admit("put"):
+            if done_cb:
+                done_cb(False, [])
+            return
         if value.id == Value.INVALID_ID:
             value.id = random_value_id()
         state = {"done": False, "done4": False, "done6": False,
@@ -1085,7 +1138,17 @@ class Dht:
     def listen(self, key: InfoHash, cb, f: Optional[Filter] = None,
                where: Optional[Where] = None) -> int:
         """Subscribe to values under a key (↔ Dht::listen,
-        src/dht.cpp:827-867).  Returns a token for cancel_listen."""
+        src/dht.cpp:827-867).  Returns a token for cancel_listen.
+
+        Returns ``None`` when ingest backpressure sheds the op at
+        admission (round 12) — never by dropping an established
+        listener.  Distinct from the pre-existing ``0`` return, which
+        means the callback consumed locally-stored values and stopped
+        (a *satisfied* listen, not a refused one); callers that only
+        care about "is there a live subscription" can keep testing
+        truthiness, the runner distinguishes the two."""
+        if not self.wave_builder.admit("listen"):
+            return None
         log.debug("[search %s] listen", key, extra={"dht_hash": bytes(key)})
         q = Query(Select(), where or Where())
         self._listener_token += 1

@@ -282,6 +282,10 @@ class Search:
         self.af = family
         self.tid = tid
         self.refill_time = _NEVER
+        #: a coalesced refill is riding the ingest wave builder (round
+        #: 12): dedupes duplicate submissions and holds off the
+        #: consecutive-bad-nodes expiry until the wave lands
+        self.refill_pending = False
         self.step_time = _NEVER
         self.next_search_step: Optional[Job] = None
         # ISSUE-4: the trace context of the op that (re)started this

@@ -351,18 +351,26 @@ class DhtRunner:
             return TIME_MAX
         with self._ops_lock:
             status = self.get_status()
+            ops = []
+            # drain BOTH queues each pump, prio first.  The reference
+            # (and this runner until round 12) skipped the normal queue
+            # whenever prio ops were pending — under sustained prio
+            # traffic (bootstrap ping storms, stats polls) normal ops
+            # could be deferred indefinitely (starvation regression
+            # test in tests/test_runner.py).  Draining prio-then-normal
+            # in one pump is the fairness bound: prio keeps strict
+            # precedence within the pump, and every pump makes progress
+            # on eligible normal ops.
             if self._pending_ops_prio:
-                ops = list(self._pending_ops_prio)
+                ops.extend(self._pending_ops_prio)
                 self._pending_ops_prio.clear()
-            elif self._pending_ops and (
+            if self._pending_ops and (
                     self.use_proxy
                     or status is NodeStatus.CONNECTED
                     or (status is NodeStatus.DISCONNECTED
                         and not self._bootstraping)):
-                ops = list(self._pending_ops)
+                ops.extend(self._pending_ops)
                 self._pending_ops.clear()
-            else:
-                ops = []
         active = self._proxy_dht if self.use_proxy else dht
         for op in ops:
             try:
@@ -613,6 +621,17 @@ class DhtRunner:
         def op(dht):
             backend_token = tracing.run_with(
                 tctx, lambda: dht.listen(key, wrapped_cb, f, where))
+            if backend_token is None:
+                # shed at ingest admission (Dht.listen's None sentinel,
+                # round 12): no subscription exists — do not register a
+                # runner record that a proxy hot-swap would faithfully
+                # re-subscribe; surface the shed as a 0 future result.
+                # (A backend return of 0 is DIFFERENT: the listener
+                # consumed local values and stopped — a satisfied op,
+                # which keeps the pre-existing success path below.)
+                listen_done(False)
+                fut.set_result(0)
+                return
             with self._listeners_lock:
                 token = self._listener_token
                 self._listener_token += 1
@@ -687,17 +706,26 @@ class DhtRunner:
                     return
                 new = self._dht
                 self.use_proxy = False
-            # re-register listeners on the new backend (:1005-1032)
+            # re-register listeners on the new backend (:1005-1032).
+            # Established subscriptions were admitted when created:
+            # exempt the re-subscribes from ingest admission so a full
+            # queue at swap time cannot shed them (round 12 — shed at
+            # admission only, never an existing listener)
+            import contextlib
+            wb = getattr(new, "wave_builder", None)
+            exempt = wb.exempt() if wb is not None else \
+                contextlib.nullcontext()
             with self._listeners_lock:
                 recs = list(self._listeners.values())
-            for rec in recs:
-                try:
-                    old.cancel_listen(rec["key"], rec["backend_token"])
-                except Exception:
-                    pass
-                rec["backend_token"] = new.listen(
-                    rec["key"], rec["cb"], rec["f"], rec["where"])
-                rec["on_proxy"] = self.use_proxy
+            with exempt:
+                for rec in recs:
+                    try:
+                        old.cancel_listen(rec["key"], rec["backend_token"])
+                    except Exception:
+                        pass
+                    rec["backend_token"] = new.listen(
+                        rec["key"], rec["cb"], rec["f"], rec["where"])
+                    rec["on_proxy"] = self.use_proxy
             # retire the previous proxy client (proxy→proxy swap or
             # fall-back to UDP): stop its maintenance/long-poll threads
             if old_client is not None and old_client is not self._proxy_client:

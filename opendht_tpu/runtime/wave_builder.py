@@ -1,0 +1,331 @@
+"""Continuous-batching ingest: coalesce live lookups into shared waves.
+
+Five rounds of kernel work made the device side of a lookup a ``[Q]``
+wave (``find_closest_nodes_batched`` → one lane-padded top-k launch for
+*many* targets), but live traffic never fed it one: every proxy/REST
+request, UDP op and embedder ``get/put/listen`` reached the table
+through a per-search refill — a Q=1 launch paying the full 128-lane
+padding tax per op.  Benchmarks batched; the service did not.
+
+This module is the ingest layer that closes that gap (ROADMAP item 2),
+the same iteration-level insight that made continuous batching the
+serving architecture for LLM engines (Orca-style: admit work
+mid-flight, keep launches full, never barrier a wave on its slowest
+member):
+
+- :class:`WaveBuilder` owns a bounded admission queue of pending
+  closest-node lookups (search refills, from ALL traffic sources — the
+  runner op queue, the proxy server, the UDP reply path's search
+  stepping).  A wave fires when the queue reaches the **fill target Q**
+  or when the oldest entry has waited the **deadline knob** (1–5 ms,
+  both ``runtime/config.py`` fields), whichever comes first — one
+  ``find_closest_nodes_batched`` launch per (family, k) group, results
+  scattered back to each search's callback.  An op that joins after a
+  wave departed simply rides the next one at whatever round it is on:
+  continuous batching, not stop-and-go batch barriers.
+- **Backpressure sheds at admission, never mid-search**: NEW ops are
+  refused (``admit``) when the queue exceeds ``ingest_queue_max`` or
+  the optional ``ingest_admit_per_sec`` sliding-window quota (the same
+  :class:`~opendht_tpu.rate_limiter.RateLimiter` the net engine's
+  ingress path uses, and the same counted-drop discipline as its
+  ``dht_net_ratelimit_drops_total``) — an admitted search's refills
+  are always queued, so backpressure can never fail an in-flight
+  search.
+- ``ingest_batching="off"`` is the escape hatch: ``submit`` resolves
+  synchronously through the identical per-op ``[1]`` launch the
+  pre-round-12 path issued — pinned result-equivalent in
+  tests/test_wave_builder.py and the burst-ingest CI smoke
+  (testing/ingest_smoke.py).
+- Observability on the PR-3/PR-4/PR-6 spine: ``dht_ingest_queue_depth``
+  gauge, ``dht_ingest_wave_occupancy`` / ``dht_ingest_queue_seconds`` /
+  ``dht_ingest_wave_seconds`` histograms, shed/wave/op counters, a
+  ``dht.search.wave`` (mode="ingest") trace span per launch with each
+  carried op's ``dht.ingest.op`` span linked to it, and the canonical
+  launch shape cost-gated from day one (profiling.py
+  ``wave_builder_lookup`` ↔ perf_budgets.json).
+
+Threading: the builder lives on the DHT thread like everything else in
+``runtime/dht.py`` — submissions come from posted closures, packet
+handlers and scheduler jobs, and the wave trigger is itself a scheduler
+job, so there are no locks and no re-entrancy (a fill-triggered wave
+fires on the *next* scheduler pump, never synchronously inside the
+submit that filled it).
+
+Reference mapping: ``DhtRunner::loop_`` (dhtrunner.cpp:387-445) drains
+all pending op *closures* onto one thread per pump — coalescing in
+time, op by op.  The TPU design deliberately diverges: we coalesce the
+ops' *device lookups* onto one launch (coalescing in the lane
+dimension), because here the padded launch — not the thread hop — is
+the per-op tax.  See PARITY.md "Continuous-batching ingest".
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+from collections import deque
+from typing import Callable, List
+
+from .. import telemetry, tracing
+from ..infohash import InfoHash
+from ..rate_limiter import RateLimiter
+
+log = logging.getLogger("opendht_tpu.ingest")
+
+#: failed-launch re-queues per entry before scattering empty (a
+#: transient device error retries on later waves; a persistent one
+#: fails the carried ops honestly after this many attempts)
+_LAUNCH_RETRIES = 2
+
+
+class _Entry:
+    """One queued lookup: target → per-search scatter callback.
+
+    ``t_enq`` is scheduler time (drives the deadline trigger);
+    ``t_wall`` is the wall clock at submit — the honest enqueue stamp
+    for the time-in-queue histogram and the ``dht.ingest.op`` span.
+    The two deliberately differ: the runner drains op closures BEFORE
+    ``periodic()`` re-syncs the scheduler clock, so scheduler time at
+    submit can be stale by a whole sleep — reconstructing span starts
+    from it put a child span seconds before its parent (caught by the
+    cross-node assembler's monotonicity check)."""
+
+    __slots__ = ("target", "af", "k", "cb", "t_enq", "t_wall", "ctx",
+                 "kind", "retries")
+
+    def __init__(self, target: InfoHash, af: int, k: int, cb: Callable,
+                 t_enq: float, t_wall: float, ctx, kind: str):
+        self.target = target
+        self.af = af
+        self.k = k
+        self.cb = cb
+        self.t_enq = t_enq
+        self.t_wall = t_wall
+        self.ctx = ctx
+        self.kind = kind
+        self.retries = 0              # failed-launch re-queues so far
+
+
+class WaveBuilder:
+    """Fill-or-deadline-triggered aggregator over
+    ``Dht.find_closest_nodes_batched`` (see module docstring)."""
+
+    def __init__(self, dht, config):
+        self._dht = dht
+        self.enabled = getattr(config, "ingest_batching", "on") != "off"
+        self.fill_target = max(1, int(
+            getattr(config, "ingest_fill_target", 64)))
+        self.deadline = float(getattr(config, "ingest_deadline", 0.002))
+        self.queue_max = int(getattr(config, "ingest_queue_max", 4096))
+        admit_qps = int(getattr(config, "ingest_admit_per_sec", 0) or 0)
+        self._admit_limiter = (RateLimiter(admit_qps) if admit_qps > 0
+                               else None)
+        self._pending: deque = deque()
+        self._job = None              # armed scheduler Job or None
+        self._exempt = 0              # admission suspended (see exempt())
+        self.waves = 0                # launches issued (cheap introspection)
+
+        reg = telemetry.get_registry()
+        self._m_depth = reg.gauge("dht_ingest_queue_depth")
+        self._m_occupancy = reg.histogram("dht_ingest_wave_occupancy")
+        self._m_queue_s = reg.histogram("dht_ingest_queue_seconds")
+        self._m_waves = reg.counter("dht_ingest_waves_total")
+        self._m_ops = {}              # kind -> counter (cached handles)
+        self._m_sheds = {}            # reason -> counter
+
+    # ------------------------------------------------------------ admission
+    def exempt(self):
+        """Context manager: suspend admission control for internal
+        continuations of ALREADY-admitted work — the proxy hot-swap
+        re-registering established listeners on the new backend must
+        never be shed (the subscription was admitted when it was
+        created; dropping it on swap would violate the never-mid-search
+        discipline)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._exempt += 1
+            try:
+                yield
+            finally:
+                self._exempt -= 1
+        return _ctx()
+
+    def admit(self, op: str) -> bool:
+        """Admission check for a NEW public op (get/put/listen/query).
+        False ⇒ the op must be refused *now*, with a counted drop —
+        the only place backpressure acts, so a search that got in can
+        always finish (its refills bypass this check via
+        :meth:`submit`).  With batching off there is no queue to
+        protect and every op is admitted (the per-op path's behavior,
+        kept result-equivalent)."""
+        if not self.enabled or self._exempt:
+            return True
+        if len(self._pending) >= self.queue_max:
+            self._shed(op, "queue_full")
+            return False
+        if self._admit_limiter is not None and not self._admit_limiter.limit(
+                self._dht.scheduler.time()):
+            self._shed(op, "rate")
+            return False
+        return True
+
+    def _shed(self, op: str, reason: str) -> None:
+        c = self._m_sheds.get((op, reason))
+        if c is None:
+            c = self._m_sheds[(op, reason)] = telemetry.get_registry(
+            ).counter("dht_ingest_sheds_total", op=op, reason=reason)
+        c.inc()
+        tr = tracing.get_tracer()
+        if tr.enabled:
+            tr.event("ingest_shed", op=op, reason=reason,
+                     depth=len(self._pending))
+        log.debug("ingest shed %s (%s, depth=%d)", op, reason,
+                  len(self._pending))
+
+    # ------------------------------------------------------------- ingest
+    def submit(self, target: InfoHash, af: int, k: int,
+               cb: Callable[[List], None], *, kind: str = "refill") -> None:
+        """Queue one closest-``k`` lookup for ``target``; ``cb(nodes)``
+        fires from the wave that carries it (immediately, with the
+        identical per-op launch, when batching is off).  Never sheds —
+        admission already happened at the op boundary."""
+        if not self.enabled:
+            cb(self._dht.find_closest_nodes_batched([target], af, k)[0])
+            return
+        now = self._dht.scheduler.time()
+        self._pending.append(_Entry(target, af, k, cb, now, _time.time(),
+                                    tracing.current(), kind))
+        depth = len(self._pending)
+        self._m_depth.set(depth)
+        c = self._m_ops.get(kind)
+        if c is None:
+            c = self._m_ops[kind] = telemetry.get_registry().counter(
+                "dht_ingest_ops_total", kind=kind)
+        c.inc()
+        # fill target ⇒ pull the trigger to *now* (the next scheduler
+        # pump — never synchronously inside a submit, see module doc);
+        # otherwise make sure a deadline trigger covers the new oldest
+        self._arm(now if depth >= self.fill_target
+                  else self._pending[0].t_enq + self.deadline)
+
+    def _arm(self, t: float) -> None:
+        job = self._job
+        if job is not None and not job.cancelled:
+            # job.time is None while the scheduler has the job in its
+            # CURRENT due sweep (run() nulls the time before executing,
+            # scheduler.py) — a submit() from a sibling due job lands
+            # here; the wave fires later this same sweep and drains the
+            # new entry, so nothing to reschedule
+            if job.time is not None and t < job.time:
+                self._job = self._dht.scheduler.edit(job, t)
+        else:
+            self._job = self._dht.scheduler.add(t, self._fire)
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    # --------------------------------------------------------------- waves
+    def _fire(self) -> None:
+        """Drain the queue into one launch per (family, k) group and
+        scatter results.  Runs as a scheduler job on the DHT thread."""
+        self._job = None
+        if not self._pending:
+            return
+        batch = list(self._pending)
+        self._pending.clear()
+        self._m_depth.set(0)
+        groups: dict = {}
+        for e in batch:
+            groups.setdefault((e.af, e.k), []).append(e)
+        for (af, k), entries in groups.items():
+            self._launch(af, k, entries)
+
+    def _launch(self, af: int, k: int, entries: List[_Entry]) -> None:
+        reg = telemetry.get_registry()
+        t_fire = _time.time()
+        with reg.span("dht_ingest_wave_seconds") as sp:
+            try:
+                results = self._dht.find_closest_nodes_batched(
+                    [e.target for e in entries], af, k)
+            except Exception:
+                log.exception("ingest wave launch failed (af=%d k=%d Q=%d)",
+                              af, k, len(entries))
+                results = None
+        if results is None:
+            # a failed launch must not fail its carried (already
+            # admitted) searches on a transient device error: re-queue
+            # each entry for the next wave, up to _LAUNCH_RETRIES.  Only
+            # after the retries are spent does an entry scatter empty —
+            # a fresh search with no candidates then expires and fails
+            # its op honestly (persistent infrastructure failure, not
+            # backpressure).
+            reg.counter("dht_ingest_wave_failures_total").inc()
+            requeue = [e for e in entries if e.retries < _LAUNCH_RETRIES]
+            exhausted = [e for e in entries if e.retries >= _LAUNCH_RETRIES]
+            for e in requeue:
+                e.retries += 1
+                self._pending.append(e)
+            if requeue:
+                self._m_depth.set(len(self._pending))
+                self._arm(self._dht.scheduler.time() + self.deadline)
+            if not exhausted:
+                return
+            entries = exhausted
+            results = [[] for _ in entries]
+        self.waves += 1
+        self._m_waves.inc()
+        self._m_occupancy.observe(len(entries))
+        for e in entries:
+            self._m_queue_s.observe(max(0.0, t_fire - e.t_wall))
+
+        # ISSUE-4 spine: one dht.search.wave span per launch (the
+        # ingest-mode sibling of the engine's wave span), each carried
+        # op linked to it by a dht.ingest.op child span under the OP'S
+        # own trace — a Perfetto load shows which wave served which op
+        # and how long the op sat in the queue.  Host-side, after the
+        # launch: tracing cannot perturb the kernel.
+        tr = tracing.get_tracer()
+        wave_ctx = None
+        wave_end = t_fire + sp.elapsed
+        if tr.enabled and any(e.ctx is not None for e in entries):
+            wave_ctx = tr.record(
+                "dht.search.wave", t_fire, sp.elapsed,
+                mode="ingest", occupancy=len(entries), af=af, k=k)
+        for e, nodes in zip(entries, results):
+            if wave_ctx is not None and e.ctx is not None:
+                # span covers submit → scatter, anchored on the entry's
+                # own wall stamp so it can never precede its parent op
+                tr.record("dht.ingest.op", e.t_wall,
+                          max(0.0, wave_end - e.t_wall),
+                          parent=e.ctx, kind="internal",
+                          op_kind=e.kind, wave_trace=wave_ctx.trace_hex,
+                          wave_span=wave_ctx.span_hex,
+                          occupancy=len(entries))
+            try:
+                e.cb(nodes)
+            except Exception:
+                log.exception("ingest scatter callback failed")
+
+    # ---------------------------------------------------------- inspection
+    def snapshot(self) -> dict:
+        """JSON-able ingest state for the ops tools (``dhtscanner
+        --json`` "ingest" section, the dhtnode REPL ``ingest`` cmd)."""
+        occ = self._m_occupancy
+        qs = self._m_queue_s
+        mean_occ = (occ.sum / occ.count) if occ.count else 0.0
+        return {
+            "batching": "on" if self.enabled else "off",
+            "fill_target": self.fill_target,
+            "deadline_s": self.deadline,
+            "queue_depth": len(self._pending),
+            "queue_max": self.queue_max,
+            "waves": self.waves,
+            "occupancy_mean": round(mean_occ, 3),
+            "occupancy_p50": round(occ.quantile(0.5), 3),
+            "occupancy_p95": round(occ.quantile(0.95), 3),
+            "queue_seconds_p50": qs.quantile(0.5),
+            "queue_seconds_p95": qs.quantile(0.95),
+            "sheds": int(sum(c.value for c in self._m_sheds.values())),
+        }

@@ -46,7 +46,16 @@ def main(argv=None) -> int:
                 end="", flush=True)
         return True
 
-    node.listen(room, on_msg, ImMessage.get_filter())
+    tok = node.listen(room, on_msg, ImMessage.get_filter())
+    try:
+        # runner.listen returns a Future resolving to the runner token;
+        # 0 = shed at ingest admission (round 12) — warn instead of
+        # silently joining a room that will never deliver messages
+        if hasattr(tok, "result") and not tok.result(10.0):
+            print("warning: listen shed by ingest backpressure — "
+                  "incoming messages will not be delivered")
+    except Exception:
+        pass
     print("Joined room %s as %s (empty line to quit)" % (args.room, my_id))
     try:
         while True:

@@ -29,6 +29,10 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            kernel + roofline attribution vs the platform
                            peaks.  Exports dht_kernel_* gauges to the
                            same registry GET /stats serves
+    ingest                 continuous-batching ingest state (round 12):
+                           queue depth, wave occupancy p50/p95 + mean,
+                           time-in-queue p50/p95, waves fired, sheds —
+                           the wave builder's live coalescing health
     trace [id|chrome [f]]  distributed tracing: no arg = recent trace
                            ids in the ring; '<trace id>' = that trace's
                            span tree; 'chrome [file]' = Perfetto/Chrome
@@ -156,6 +160,27 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                     print(line)
                 print("%d kernels; budgets gated by ci/perf_gate.py "
                       "(perf_budgets.json)" % len(entries))
+            elif op == "ingest":
+                # continuous-batching ingest health (round 12): the
+                # wave builder's snapshot — same numbers dhtscanner
+                # --json reports under "ingest" and the proxy exports
+                # as dht_ingest_* series
+                try:
+                    snap = node._dht.wave_builder.snapshot()
+                except AttributeError:
+                    print("ingest state unavailable (proxy backend?)")
+                    continue
+                print("batching %s  fill_target %d  deadline %.1f ms  "
+                      "queue %d/%d" % (
+                          snap["batching"], snap["fill_target"],
+                          snap["deadline_s"] * 1e3,
+                          snap["queue_depth"], snap["queue_max"]))
+                print("waves %d  occupancy mean %.2f p50 %.1f p95 %.1f"
+                      % (snap["waves"], snap["occupancy_mean"],
+                         snap["occupancy_p50"], snap["occupancy_p95"]))
+                print("time-in-queue p50 %.3f ms  p95 %.3f ms  sheds %d"
+                      % (snap["queue_seconds_p50"] * 1e3,
+                         snap["queue_seconds_p95"] * 1e3, snap["sheds"]))
             elif op == "trace":
                 import json as _json
                 from .. import tracing

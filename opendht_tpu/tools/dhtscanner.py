@@ -60,9 +60,18 @@ def topology_snapshot(node) -> dict:
         "storage": {},
         "metrics_gauges": {},
         "maintenance": {},
+        "ingest": {},
         "kernels": {},
         "events": [],
     }
+    try:
+        # round-12 ingest surface: the wave builder's queue depth /
+        # occupancy p50-p95 / time-in-queue / shed state, so the soak
+        # harness can diff how well live traffic coalesced (and whether
+        # backpressure fired) between snapshots
+        snap["ingest"] = node._dht.wave_builder.snapshot()
+    except Exception:
+        pass
     try:
         # kernel cost ledger (ISSUE-6): report whatever is already
         # computed — the snapshot must stay cheap enough for every soak

@@ -155,6 +155,21 @@ def test_repl_proxy_ops(net, monkeypatch):
         server.stop()
 
 
+def test_repl_ingest_state(net, monkeypatch):
+    """The round-12 `ingest` command surfaces the wave builder's
+    coalescing health (queue depth, occupancy, time-in-queue, sheds)."""
+    peer, node = net
+    out = repl(node, [
+        "p ingest-repl-key some value",    # drive at least one wave
+        "ingest",
+        "x",
+    ], monkeypatch)
+    assert "batching on" in out
+    assert re.search(r"queue \d+/\d+", out)
+    assert re.search(r"waves \d+  occupancy mean", out)
+    assert re.search(r"time-in-queue p50 .* sheds \d+", out)
+
+
 def test_repl_log_toggle(net, monkeypatch):
     peer, node = net
     out = repl(node, ["log", "log off", "x"], monkeypatch)

@@ -301,3 +301,40 @@ def test_secure_dht_over_proxy(topology):
     vals = peer.get_sync(key, timeout=20.0)
     got = [v for v in vals if v.data == b"signed-over-rest"]
     assert got and got[0].is_signed() and got[0].check_signature()
+
+
+def test_listen_and_subscribe_shed_return_503():
+    """Round-12 review regression: a backend listen shed at ingest
+    admission (Dht.listen's 0 sentinel) must surface as an HTTP error
+    on the proxy's LISTEN stream and SUBSCRIBE registration — never an
+    open heartbeat stream or a push token for a subscription that does
+    not exist."""
+    import urllib.error
+    from opendht_tpu.runtime import Config
+
+    r = DhtRunner()
+    try:
+        # queue_max=0 sheds every new op at admission
+        r.run(0, RunnerConfig(dht_config=Config(ingest_queue_max=0)))
+        server = DhtProxyServer(r, 0)
+        try:
+            key_hex = InfoHash.get("shed-proxy").hex()
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/%s" % (server.port, key_hex),
+                method="LISTEN")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=15)
+            assert ei.value.code == 503
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/%s" % (server.port, key_hex),
+                data=json.dumps({"client_id": "shed-c"}).encode(),
+                method="SUBSCRIBE",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=15)
+            assert ei.value.code == 503
+            assert server.get_stats().push_listeners_count == 0
+        finally:
+            server.stop()
+    finally:
+        r.join()

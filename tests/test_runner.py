@@ -175,3 +175,52 @@ def test_join_idempotent():
     r.join()
     r.join()
     assert not r.is_running()
+
+
+def test_prio_ops_cannot_starve_normal_ops():
+    """Starvation regression (round 12): sustained prio traffic — every
+    pump finds the prio queue non-empty again — must not indefinitely
+    defer normal ops.  Before the fix, ``_loop``'s elif skipped the
+    normal queue whenever prio ops were pending, so a prio source that
+    re-arms each pump (bootstrap ping storms, stats polls) deferred
+    every get/put/listen forever.  The fairness bound: each pump drains
+    prio first, then the eligible normal backlog."""
+    r = DhtRunner()
+    r.run(0, RunnerConfig(threaded=False))
+    try:
+        order = []
+        r._post(lambda dht: order.append("normal"))
+
+        def rearm(dht):
+            order.append("prio")
+            r._post(rearm, prio=True)     # the queue is never observed empty
+
+        r._post(rearm, prio=True)
+        for _ in range(4):
+            r.loop()
+        assert "normal" in order, \
+            "normal op starved behind sustained prio traffic"
+        # prio keeps strict precedence within its pump
+        assert order.index("prio") < order.index("normal")
+    finally:
+        r.join()
+
+
+def test_normal_ops_still_gated_while_bootstrapping():
+    """The fairness fix must not weaken the reference's gating: while a
+    bootstrap attempt is in flight (disconnected + bootstrapping),
+    normal ops stay queued; prio ops run (dhtrunner.cpp:393-398)."""
+    r = DhtRunner()
+    r.run(0, RunnerConfig(threaded=False))
+    try:
+        r._bootstraping = True            # simulate the bootstrap thread
+        ran = []
+        r._post(lambda dht: ran.append("normal"))
+        r._post(lambda dht: ran.append("prio"), prio=True)
+        r.loop()
+        assert ran == ["prio"], ran
+        r._bootstraping = False
+        r.loop()
+        assert ran == ["prio", "normal"], ran
+    finally:
+        r.join()

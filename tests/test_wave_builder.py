@@ -1,0 +1,415 @@
+"""Continuous-batching ingest (round 12, runtime/wave_builder.py).
+
+Pins the wave builder's contract: live search refills coalesce into
+shared ``find_closest_nodes_batched`` launches (fill- OR
+deadline-triggered), the ``ingest_batching="off"`` escape hatch is
+result-equivalent to the per-op dispatch path, backpressure sheds NEW
+ops at admission (counted) and never an in-flight search, and the
+PR-3/PR-4 observability spine sees every wave (occupancy/time-in-queue
+histograms, per-op trace spans linked to the carrying wave span).
+"""
+
+from __future__ import annotations
+
+import random
+import socket as _socket
+
+import numpy as np
+
+from opendht_tpu import telemetry, tracing
+from opendht_tpu.core.value import Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.runtime import Config, Dht
+from opendht_tpu.runtime.live_search import SEARCH_NODES
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+
+AF = _socket.AF_INET
+
+
+def make_dht(clock, n_nodes=12, **cfg_kw):
+    """A v4-only Dht on a virtual clock with a populated table and a
+    swallow-everything transport (deterministic peer ids)."""
+    cfg = Config(**cfg_kw)
+    dht = Dht(lambda data, addr: 0, config=cfg,
+              scheduler=Scheduler(clock=lambda: clock["t"]),
+              has_v6=False)
+    rng = np.random.default_rng(1234)
+    table = dht.tables[AF]
+    added = 0
+    while added < n_nodes:
+        h = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+        if table.insert(h, SockAddr("10.9.0.%d" % (added + 1), 4500),
+                        now=clock["t"], confirm=2) is not None:
+            added += 1
+    return dht
+
+
+def spy_batched(dht):
+    """Wrap dht.find_closest_nodes_batched, recording (Q, af, k) per
+    underlying device resolve."""
+    calls = []
+    orig = dht.find_closest_nodes_batched
+
+    def wrapper(targets, af, count=8):
+        calls.append((len(targets), af, count))
+        return orig(targets, af, count)
+
+    dht.find_closest_nodes_batched = wrapper
+    return calls
+
+
+def _occ(reg=None):
+    return (reg or telemetry.get_registry()).histogram(
+        "dht_ingest_wave_occupancy")
+
+
+def test_fill_trigger_coalesces_concurrent_ops():
+    """fill_target ops queued in one pump ride ONE [Q] launch."""
+    clock = {"t": 1000.0}
+    dht = make_dht(clock, ingest_fill_target=4, ingest_deadline=5.0)
+    calls = spy_batched(dht)
+    occ0 = _occ().count
+    done = []
+    for i in range(4):
+        dht.get(InfoHash.get(f"wave-fill-{i}"),
+                done_cb=lambda ok, ns: done.append(ok))
+    assert not calls, "refills must queue, not dispatch per-op"
+    assert dht.wave_builder.pending() == 4
+    dht.scheduler.run()          # fill target pulled the trigger to now
+    assert calls == [(4, AF, SEARCH_NODES)], calls
+    assert dht.wave_builder.pending() == 0
+    occ = _occ()
+    assert occ.count == occ0 + 1
+    # every search got its candidates and is stepping
+    for i in range(4):
+        sr = dht.searches[AF][InfoHash.get(f"wave-fill-{i}")]
+        assert not sr.refill_pending and len(sr.nodes) > 0
+
+
+def test_deadline_trigger_fires_partial_wave():
+    """Below the fill target, the oldest entry's deadline fires the
+    wave — a trickle op is never stranded."""
+    clock = {"t": 2000.0}
+    dht = make_dht(clock, ingest_fill_target=64, ingest_deadline=0.002)
+    calls = spy_batched(dht)
+    dht.get(InfoHash.get("wave-dl-a"))
+    dht.get(InfoHash.get("wave-dl-b"))
+    dht.scheduler.run()
+    assert not calls, "deadline not reached: no launch yet"
+    clock["t"] += 0.0025
+    dht.scheduler.run()
+    assert calls == [(2, AF, SEARCH_NODES)]
+
+
+def test_off_path_is_result_equivalent():
+    """batching="off" resolves synchronously through the identical
+    per-op launch: same rows, same order, as the batched wave and as a
+    direct find_closest_nodes_batched call."""
+    clock = {"t": 3000.0}
+    off = make_dht(clock, ingest_batching="off")
+    assert not off.wave_builder.enabled
+    targets = [InfoHash.get(f"equiv-{i}") for i in range(5)]
+    got = []
+    for t in targets:
+        off.wave_builder.submit(t, AF, SEARCH_NODES,
+                                lambda nodes: got.append(nodes))
+    assert len(got) == 5, "off path must resolve synchronously"
+    direct = off.find_closest_nodes_batched(targets, AF, SEARCH_NODES)
+    assert [[n.id for n in row] for row in got] == \
+        [[n.id for n in row] for row in direct]
+
+    # and the batched path returns the same candidate rows (same table
+    # content, same kernel) once its wave fires
+    on = make_dht(clock, ingest_fill_target=5, ingest_deadline=5.0)
+    got_on = []
+    for t in targets:
+        on.wave_builder.submit(t, AF, SEARCH_NODES,
+                               lambda nodes: got_on.append(nodes))
+    on.scheduler.run()
+    assert [[n.id for n in row] for row in got_on] == \
+        [[n.id for n in row] for row in direct]
+
+
+def test_admission_shed_on_full_queue_counted():
+    """Over ingest_queue_max, a NEW op is refused at admission with a
+    counted drop; queued (in-flight) lookups are untouched."""
+    clock = {"t": 4000.0}
+    dht = make_dht(clock, ingest_queue_max=2, ingest_fill_target=64,
+                   ingest_deadline=5.0)
+    reg = telemetry.get_registry()
+    shed_c = reg.counter("dht_ingest_sheds_total", op="get",
+                         reason="queue_full")
+    shed0 = shed_c.value
+    results = []
+    dht.get(InfoHash.get("shed-a"), done_cb=lambda ok, ns:
+            results.append(("a", ok)))
+    dht.get(InfoHash.get("shed-b"), done_cb=lambda ok, ns:
+            results.append(("b", ok)))
+    assert dht.wave_builder.pending() == 2
+    dht.get(InfoHash.get("shed-c"), done_cb=lambda ok, ns:
+            results.append(("c", ok)))
+    assert ("c", False) in results, "shed op must fail fast at admission"
+    assert shed_c.value == shed0 + 1
+    assert dht.wave_builder.pending() == 2, \
+        "a shed op must not enqueue work"
+    # a shed listen returns the None sentinel (no subscription leaked;
+    # distinct from the pre-existing 0 = satisfied-by-local-values stop)
+    assert dht.listen(InfoHash.get("shed-l"),
+                      lambda vals, exp: True) is None
+    # the queued ops still complete when their wave fires
+    dht.scheduler.run()
+    clock["t"] += 6.0
+    dht.scheduler.run()
+    for key in ("shed-a", "shed-b"):
+        sr = dht.searches[AF][InfoHash.get(key)]
+        assert len(sr.nodes) > 0
+
+
+def test_admission_rate_limiter_quota():
+    """ingest_admit_per_sec rides the same sliding-window RateLimiter
+    as the net engine's ingress quotas."""
+    clock = {"t": 5000.0}
+    dht = make_dht(clock, ingest_admit_per_sec=2, ingest_deadline=5.0)
+    results = []
+    for i in range(3):
+        dht.get(InfoHash.get(f"quota-{i}"),
+                done_cb=lambda ok, ns, _i=i: results.append((_i, ok)))
+    assert (2, False) in results
+    assert dht.wave_builder.pending() == 2
+    clock["t"] += 1.1              # window slides: admissions resume
+    dht.scheduler.sync_time()
+    dht.get(InfoHash.get("quota-late"),
+            done_cb=lambda ok, ns: results.append(("late", ok)))
+    assert ("late", False) not in results
+    assert dht.wave_builder.pending() == 3
+
+
+def test_pending_refill_defers_bad_node_expiry():
+    """A step before the wave lands must not expire the (legitimately
+    empty) search: 0 >= min(0, MAX) is suspended while refill_pending."""
+    clock = {"t": 6000.0}
+    dht = make_dht(clock, ingest_fill_target=64, ingest_deadline=0.002)
+    dht.get(InfoHash.get("pending-expire"))
+    sr = dht.searches[AF][InfoHash.get("pending-expire")]
+    assert sr.refill_pending and not sr.nodes
+    dht._search_step(sr)
+    assert not sr.expired, \
+        "search expired before its coalesced refill landed"
+    clock["t"] += 0.0025
+    dht.scheduler.run()
+    assert not sr.refill_pending and len(sr.nodes) > 0 and not sr.expired
+
+
+def test_per_op_trace_spans_link_to_wave_span():
+    """Each carried op gets a dht.ingest.op span under ITS trace,
+    linked to the dht.search.wave (mode="ingest") span of the wave
+    that carried it (ISSUE tentpole observability)."""
+    clock = {"t": 7000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=5.0)
+    tr = tracing.get_tracer()
+    roots = [tracing.TraceContext.new_root() for _ in range(2)]
+    for i, ctx in enumerate(roots):
+        with tracing.activate(ctx):
+            dht.get(InfoHash.get(f"trace-{i}"))
+    dht.scheduler.run()
+    spans = tr.dump()["spans"]
+    waves = [s for s in spans if s["name"] == "dht.search.wave"
+             and s["attrs"].get("mode") == "ingest"
+             and s["attrs"].get("occupancy") == 2]
+    assert waves, "no ingest-mode wave span recorded"
+    wave = waves[-1]
+    op_spans = [s for s in spans if s["name"] == "dht.ingest.op"
+                and s["attrs"].get("wave_span") == wave["span_id"]]
+    assert len(op_spans) == 2
+    got_traces = {s["trace_id"] for s in op_spans}
+    want_traces = {c.trace_hex for c in roots}
+    assert got_traces == want_traces, \
+        "op spans must live in the originating ops' traces"
+
+
+def test_virtualnet_put_get_equivalence_on_vs_off():
+    """End-to-end pin: the same virtual cluster + workload returns the
+    same values and lands them on the same storers with batching on and
+    off (the acceptance-criteria equivalence, in-process twin of the
+    burst-ingest CI smoke)."""
+    from opendht_tpu.testing.virtual_net import VirtualNet
+
+    def run(batching: str):
+        random.seed(99)
+        net = VirtualNet(seed=7)
+        cfg = lambda i: Config(  # noqa: E731
+            node_id=InfoHash.get(f"wb-eq-node-{i}"),
+            ingest_batching=batching)
+        nodes = [net.add_node(cfg(i)) for i in range(6)]
+        for n in nodes[1:]:
+            net.bootstrap_node(n, nodes[0])
+        net.run(max_time=30.0)
+        key = InfoHash.get("wb-eq-key")
+        done = {}
+        nodes[1].put(key, Value(b"wb-equivalence", value_id=7),
+                     lambda ok, ns: done.setdefault("put", ok))
+        net.run(max_time=30.0)
+        got = []
+        nodes[2].get(key, get_cb=lambda vals: got.extend(vals) or True,
+                     done_cb=lambda ok, ns: done.setdefault("get", ok))
+        net.run(max_time=30.0)
+        storers = sorted(bytes(d.myid).hex()
+                         for d in net.storers_of(key))
+        return (done, sorted(v.data for v in got), storers)
+
+    done_on, vals_on, storers_on = run("on")
+    done_off, vals_off, storers_off = run("off")
+    assert done_on.get("put") and done_off.get("put")
+    assert vals_on == vals_off == [b"wb-equivalence"]
+    assert storers_on == storers_off
+
+
+def test_snapshot_surfaces_ingest_state():
+    clock = {"t": 8000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=5.0)
+    dht.get(InfoHash.get("snap-a"))
+    dht.get(InfoHash.get("snap-b"))
+    dht.scheduler.run()
+    snap = dht.wave_builder.snapshot()
+    assert snap["batching"] == "on"
+    assert snap["waves"] >= 1
+    assert snap["occupancy_mean"] >= 1.0
+    assert snap["queue_depth"] == 0
+    # the series the proxy /stats route exports are registered
+    prom = telemetry.get_registry().prometheus()
+    for series in ("dht_ingest_queue_depth", "dht_ingest_wave_occupancy",
+                   "dht_ingest_queue_seconds", "dht_ingest_waves_total"):
+        assert series in prom, series
+
+
+def test_scanner_snapshot_has_ingest_section():
+    """dhtscanner --json surfaces the wave builder's live state (round
+    12 ops surface) — runs crypto-less via the lazy tools.common
+    import, like the soak harness."""
+    from opendht_tpu.runtime.runner import DhtRunner
+    from opendht_tpu.tools.dhtscanner import topology_snapshot
+
+    r = DhtRunner()
+    try:
+        r.run(0)
+        snap = topology_snapshot(r)
+        ing = snap["ingest"]
+        assert ing["batching"] == "on"
+        for field in ("queue_depth", "queue_max", "waves",
+                      "occupancy_p50", "occupancy_p95",
+                      "queue_seconds_p95", "sheds", "fill_target",
+                      "deadline_s"):
+            assert field in ing, field
+    finally:
+        r.join()
+
+
+def test_submit_from_sibling_due_job_same_sweep():
+    """Review regression: Scheduler.run() nulls job.time on every due
+    job BEFORE executing the sweep, so a submit() issued from another
+    due job (a search step's refill) while the wave deadline job is in
+    the same sweep must not crash _arm (it compared t < None) — and the
+    wave that fires later in the sweep must carry the new entry too."""
+    clock = {"t": 9000.0}
+    dht = make_dht(clock, ingest_fill_target=64, ingest_deadline=0.002)
+    calls = spy_batched(dht)
+    got = []
+    dht.wave_builder.submit(InfoHash.get("sweep-a"), AF, SEARCH_NODES,
+                            lambda nodes: got.append("a"))
+    # a sibling job due EARLIER in the same sweep submits mid-sweep,
+    # while the wave job's heap entry already has time = None
+    dht.scheduler.add(clock["t"] + 0.001, lambda: dht.wave_builder.submit(
+        InfoHash.get("sweep-b"), AF, SEARCH_NODES,
+        lambda nodes: got.append("b")))
+    clock["t"] += 0.0025
+    dht.scheduler.run()
+    assert got == ["a", "b"], got
+    assert calls and calls[0][0] == 2, calls
+    assert dht.wave_builder.pending() == 0
+
+
+def test_runner_listen_shed_resolves_zero_no_record():
+    """Review regression: a backend listen shed at ingest admission
+    must resolve the runner future to the 0 sentinel WITHOUT
+    registering a runner listener record (a proxy hot-swap would
+    otherwise faithfully re-subscribe a subscription that never
+    existed)."""
+    from opendht_tpu.runtime import Config
+    from opendht_tpu.runtime.runner import DhtRunner, RunnerConfig
+
+    r = DhtRunner()
+    try:
+        # queue_max=0 sheds every new op at admission
+        r.run(0, RunnerConfig(dht_config=Config(ingest_queue_max=0)))
+        fut = r.listen(InfoHash.get("shed-runner-listen"),
+                       lambda vals, exp: True)
+        assert fut.result(10.0) == 0
+        assert len(r._listeners) == 0, "shed listen leaked a record"
+    finally:
+        r.join()
+
+
+def test_failed_launch_requeues_then_exhausts():
+    """Review regression: a transient device error on a wave launch
+    must NOT fail the carried (already admitted) searches — entries
+    re-queue for later waves; only after the retry budget is spent do
+    they scatter empty (persistent failure)."""
+    clock = {"t": 10_000.0}
+    dht = make_dht(clock, ingest_fill_target=64, ingest_deadline=0.002)
+    from opendht_tpu.runtime.wave_builder import _LAUNCH_RETRIES
+    from opendht_tpu import telemetry
+    fail = {"n": 0}
+    orig = dht.find_closest_nodes_batched
+
+    def flaky(targets, af, count=8):
+        if fail["n"] > 0:
+            fail["n"] -= 1
+            raise RuntimeError("transient device error")
+        return orig(targets, af, count)
+
+    dht.find_closest_nodes_batched = flaky
+    failures = telemetry.get_registry().counter(
+        "dht_ingest_wave_failures_total")
+    f0 = failures.value
+
+    # one transient failure: the retry wave succeeds and the search
+    # gets its candidates — the op is never failed
+    fail["n"] = 1
+    got = []
+    dht.wave_builder.submit(InfoHash.get("retry-ok"), AF, SEARCH_NODES,
+                            lambda nodes: got.append(nodes))
+    for _ in range(_LAUNCH_RETRIES + 1):
+        clock["t"] += 0.0025
+        dht.scheduler.sync_time()
+        dht.scheduler.run()
+    assert got and len(got[0]) > 0, "retry wave never delivered"
+    assert failures.value == f0 + 1
+
+    # persistent failure: after the retry budget the entry scatters
+    # empty (the search then expires honestly)
+    fail["n"] = _LAUNCH_RETRIES + 1
+    got2 = []
+    dht.wave_builder.submit(InfoHash.get("retry-dead"), AF, SEARCH_NODES,
+                            lambda nodes: got2.append(nodes))
+    for _ in range(_LAUNCH_RETRIES + 2):
+        clock["t"] += 0.0025
+        dht.scheduler.sync_time()
+        dht.scheduler.run()
+    assert got2 == [[]], got2
+    assert dht.wave_builder.pending() == 0
+
+
+def test_proxy_hotswap_resubscribe_exempt_from_admission():
+    """Review regression: enable_proxy re-registers established
+    listeners on the new backend under WaveBuilder.exempt() — a full
+    admission queue at swap time must not shed subscriptions that were
+    already admitted when created."""
+    clock = {"t": 11_000.0}
+    dht = make_dht(clock, ingest_queue_max=0)   # sheds every NEW op
+    assert dht.wave_builder.admit("get") is False
+    with dht.wave_builder.exempt():
+        assert dht.wave_builder.admit("listen") is True
+        tok = dht.listen(InfoHash.get("exempt-l"), lambda v, e: True)
+        assert tok, "exempted listen was shed"
+    assert dht.wave_builder.admit("get") is False
