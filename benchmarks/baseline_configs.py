@@ -114,9 +114,10 @@ def config3_tp(Q: int = 0, N: int = 0, limbs: int = 0) -> dict:
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
     from bench import chain_slope
-    from opendht_tpu.ops.sorted_table import default_lut_bits, sort_table
+    from opendht_tpu.ops.sorted_table import sort_table
     from opendht_tpu.core.search import ALPHA, SEARCH_NODES
-    from opendht_tpu.parallel import make_mesh, pad_to_multiple
+    from opendht_tpu.parallel import (make_mesh, pad_to_multiple,
+                                      shard_table_state)
     from opendht_tpu.parallel.sharded import build_tp_lookup
 
     n_dev = len(jax.devices())
@@ -134,25 +135,28 @@ def config3_tp(Q: int = 0, N: int = 0, limbs: int = 0) -> dict:
     padded, _ = pad_to_multiple(np.asarray(sorted_ids), mesh.shape["t"])
     shard_n = padded.shape[0] // mesh.shape["t"]
 
-    fn = build_tp_lookup(mesh, shard_n, Q, 8, 3, SEARCH_NODES, 48,
-                         default_lut_bits(shard_n), limbs,
-                         block_bits=default_lut_bits(N))
-    sorted_placed = jax.device_put(jnp.asarray(padded),
-                                   NamedSharding(mesh, P("t", None)))
+    # round 13: one shard_table_state call builds + places the
+    # row-sharded table state (sorted rows, per-shard LUT, replicated
+    # global block LUT) — the block width defaults to
+    # default_lut_bits(N) for single-device bit-identity
+    state = shard_table_state(mesh, padded, n_valid)
+    fn = build_tp_lookup(mesh, shard_n, Q, 8, 3, SEARCH_NODES, 48, limbs)
+    a = state.arrays
     targets_placed = jax.device_put(targets, NamedSharding(mesh, P("q", None)))
-    nv = jnp.asarray(n_valid, jnp.int32)
 
     out = jax.block_until_ready(
-        fn(sorted_placed, nv, targets_placed, jnp.int32(1)))
+        fn(a["sorted_ids"], a["local_lut"], a["block_lut"], a["n_valid"],
+           targets_placed, jnp.int32(1)))
     hops = np.asarray(out["hops"])
     conv = float(np.asarray(out["converged"]).mean())
 
-    def body(t, sorted_placed, nv):
-        o = fn(sorted_placed, nv, t, jnp.int32(1))
+    def body(t, s, lut, blk, nv):
+        o = fn(s, lut, blk, nv, t, jnp.int32(1))
         return (jnp.sum(o["hops"].astype(jnp.float32))
                 + jnp.sum(o["converged"].astype(jnp.float32)))
 
-    dt = chain_slope(body, targets_placed, sorted_placed, nv, r1=1, r2=4)
+    dt = chain_slope(body, targets_placed, a["sorted_ids"], a["local_lut"],
+                     a["block_lut"], a["n_valid"], r1=1, r2=4)
     return {"metric": "config3-tp table-sharded iterative search, mesh "
                       "q=%d t=%d (table %d rows/shard), %d lookups x %d "
                       "nodes, state_limbs=%d; p50 hops %d, converged %.3f "

@@ -241,6 +241,70 @@ def check_config_captures(failures):
     return checked
 
 
+def check_tp_wire(failures):
+    """Round-13 rule, BOTH directions: README and PARITY must each
+    carry a ``<!-- tp:wire -->``-tagged paragraph quoting the
+    t-sharded engine's in-loop collective budget — the per-hop
+    bytes/query figure ('NNN B per query per hop') and the in-loop
+    site count ('N in-loop collective') — and every quoted figure must
+    EQUAL the committed TP_SCALING.json (the values are read off the
+    compiled HLO, deterministic, so the band is exact).  A regenerated
+    artifact with stale quotes fails; a quote with no artifact backing
+    fails via the missing-tag branch."""
+    tp_path = os.path.join(ROOT, "TP_SCALING.json")
+    if not os.path.exists(tp_path):
+        failures.append("TP_SCALING.json missing — regenerate with "
+                        "python benchmarks/tp_scaling.py")
+        return
+    with open(tp_path) as f:
+        rows = json.load(f).get("rows") or []
+    if not rows:
+        failures.append("TP_SCALING.json has no rows")
+        return
+    want_bytes = rows[0]["bytes_per_local_query_per_hop"]
+    want_sites = rows[0]["collective_sites_in_loop"]
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if "<!-- tp:wire -->" in ln]
+        if not tagged:
+            failures.append(f"{name}: no '<!-- tp:wire -->'-tagged "
+                            f"paragraph quoting the t-sharded collective "
+                            f"budget (TP_SCALING.json)")
+            continue
+        for li in tagged:
+            lo = li
+            while lo > 0 and lines[lo - 1].strip():
+                lo -= 1
+            hi = li
+            while hi + 1 < len(lines) and lines[hi + 1].strip():
+                hi += 1
+            para = " ".join(lines[lo:hi + 1])
+            quoted_b = [float(v) for v in re.findall(
+                r"(\d+(?:\.\d+)?) ?B(?:ytes)? per query per hop", para)]
+            quoted_s = [int(v) for v in re.findall(
+                r"(\d+) in-loop collective", para)]
+            if not quoted_b:
+                failures.append(f"{name}: [tp:wire] paragraph quotes no "
+                                f"'NNN B per query per hop' figure")
+            for qb in quoted_b:
+                if qb != float(want_bytes):
+                    failures.append(
+                        f"{name}: [tp:wire] quotes {qb:g} B per query per "
+                        f"hop vs TP_SCALING.json {want_bytes} (exact match "
+                        f"required — the value is read off the HLO)")
+            if not quoted_s:
+                failures.append(f"{name}: [tp:wire] paragraph quotes no "
+                                f"'N in-loop collective' count")
+            for qs in quoted_s:
+                if qs != int(want_sites):
+                    failures.append(
+                        f"{name}: [tp:wire] quotes {qs} in-loop "
+                        f"collective(s) vs TP_SCALING.json {want_sites}")
+
+
 def check_trajectory(failures):
     """The BENCH trajectory, enforced BOTH directions (ISSUE-6
     satellite): the committed PERF_TRAJECTORY.json must equal a fresh
@@ -298,6 +362,7 @@ def main() -> int:
     failures = []
     cap = check_headline(failures)
     checked = check_config_captures(failures)
+    check_tp_wire(failures)
     check_trajectory(failures)
     if failures:
         print("DOCS DRIFT from capture artifacts:")

@@ -243,6 +243,28 @@ spec.loader.exec_module(m)
 assert len(jax.devices()) == 8
 m.main(["-c", "3", "--tp", "-N", "65536", "-Q", "1024"])
 PY
+# row-sharded table smoke (round 13, ROADMAP item 1): one t=4 sharded
+# wave on the 8-device virtual mesh.  Asserts the compiled HLO's
+# in-loop collective-site count AND bytes/query/hop EQUAL the
+# committed TP_SCALING.json values (drift fails BOTH directions — an
+# extra in-loop collective and an unrecorded fusion alike), the
+# per-shard resident table stays inside the N/t*5*4 B*(1+eps) bound,
+# and the wave is bit-identical to the single-device engine.
+python - <<'PY'
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_shard_r13", pathlib.Path("benchmarks/exp_shard_r13.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "row-sharded table smoke failed"
+PY
 # kernel cost-model perf gate (round 11, ROADMAP item 3): every shipped
 # kernel's lowered XLA cost model (flops / bytes accessed / arg+output
 # bytes at its canonical shape) must sit inside the committed

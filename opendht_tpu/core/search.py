@@ -695,7 +695,7 @@ _TRACE_MAX_ROUND_SPANS = 64
 
 
 def record_wave(out, elapsed_s: float, wave_width: int, *,
-                mode: str = "single") -> None:
+                mode: str = "single", mesh_t: int = 1) -> None:
     """Feed one completed search wave into the telemetry spine
     (ISSUE-3): ``dht_search_wave_seconds`` (the OPEN ≤8 ms 1024-wave
     p50 bound is exactly this histogram's p50 at width 1024, PARITY.md),
@@ -743,7 +743,7 @@ def record_wave(out, elapsed_s: float, wave_width: int, *,
         # quantified by captures/ledger_overhead.json.
         from .. import profiling
         cost = profiling.wave_attrs(int(wave_width), rounds, elapsed_s,
-                                    mode=mode)
+                                    mode=mode, mesh_t=mesh_t)
         wave_ctx = tr.record("dht.search.wave", start, elapsed_s,
                              parent=ctx, mode=mode,
                              width=int(wave_width), rounds=rounds, **cost)

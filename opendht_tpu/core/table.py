@@ -98,8 +98,10 @@ class Snapshot:
         self.version = version
         self.mask_key = mask_key
         self._expanded = None             # lazy expand_table
+        self._tp_state = None             # lazy (mesh, placed dict)
 
-    def lookup(self, queries, *, k: int = TARGET_NODES, window: int = 128):
+    def lookup(self, queries, *, k: int = TARGET_NODES, window: int = 128,
+               mesh=None):
         """Batched exact k-closest.  queries: uint32 [Q,5] (device or np).
         Returns (rows [Q,k] int32 numpy, dist [Q,k,5] numpy) with -1 padding.
 
@@ -112,14 +114,68 @@ class Snapshot:
         exact full scan on device inside lookup_topk.  No prefix LUT:
         routing-table ids cluster around self_id by design, so LUT
         buckets degenerate — the plain log2(cap)-step positioning
-        search is both exact and cheap at routing-table sizes."""
+        search is both exact and cheap at routing-table sizes.
+
+        ``mesh`` (round 13, ``config.resolve_mesh_t``): a (q=1, t)
+        device mesh row-shards the resolve — per-shard windowed top-k
+        over each shard's contiguous slice of the sorted slab, ONE
+        cross-shard merge collective (parallel/sharded.py
+        ``sharded_window_lookup``) — so the resolve table scales past
+        one device's HBM.  Exact either way; results identical (the
+        window kernel's certificate decertifies into the shard-local
+        full scan)."""
         q = jnp.asarray(queries, jnp.uint32)
+        if mesh is not None and mesh.shape.get("t", 1) > 1:
+            return self._lookup_sharded(mesh, q, k, window)
         if self._expanded is None:
             self._expanded = expand_table(self.sorted_ids)
         dist, idx, _ = lookup_topk(self.sorted_ids, self.n_valid, q, k=k,
                                    expanded=self._expanded)
         idx = np.asarray(idx)
         rows = np.where(idx >= 0, np.asarray(self.perm)[np.clip(idx, 0, None)], -1)
+        return rows.astype(np.int32), np.asarray(dist)
+
+    def _shard_state(self, mesh):
+        """Row-shard this snapshot's sorted slab over the mesh ``t``
+        axis ONCE (declarative placement — parallel/partition.py) and
+        cache the placed operands; subsequent waves reuse them with
+        zero copies (the shard fns are placement-idempotent)."""
+        st = self._tp_state
+        if st is not None and st[0] is mesh:
+            return st[1]
+        from ..parallel import partition
+        from ..parallel.sharded import pad_to_multiple
+        n_t = mesh.shape["t"]
+        cap = self.sorted_ids.shape[0]
+        ids = self.sorted_ids
+        if cap % n_t:
+            # append-pad on host; pad rows land past the valid prefix
+            # (the last shard) and every shard excludes rows beyond its
+            # local n_valid, so their content never participates
+            ids, _ = pad_to_multiple(np.asarray(ids), n_t)
+        shard_n = ids.shape[0] // n_t
+        n = int(self.n_valid)
+        nv = np.clip(n - np.arange(n_t) * shard_n, 0,
+                     shard_n).astype(np.int32)
+        # per-shard LOCAL sorted positions: the sharded kernel offsets
+        # them by the shard base, yielding global sorted positions that
+        # this snapshot's perm then maps to slab rows host-side
+        perm_local = np.tile(np.arange(shard_n, dtype=np.int32), n_t)
+        placed = partition.shard_put(
+            mesh, {"sorted_ids": ids, "perm": perm_local, "n_valid": nv},
+            partition.TABLE_AXIS_RULES)
+        self._tp_state = (mesh, placed)
+        return placed
+
+    def _lookup_sharded(self, mesh, q, k: int, window: int):
+        from ..parallel.sharded import sharded_window_lookup
+        placed = self._shard_state(mesh)
+        dist, gpos = sharded_window_lookup(
+            mesh, q, placed["sorted_ids"], placed["perm"],
+            placed["n_valid"], k=k, window=window)
+        gpos = np.asarray(gpos)
+        rows = np.where(gpos >= 0,
+                        np.asarray(self.perm)[np.clip(gpos, 0, None)], -1)
         return rows.astype(np.int32), np.asarray(dist)
 
 
@@ -316,6 +372,9 @@ class NodeTable:
         self._maint_key = None            # reusable refresh-target PRNG
                                           # key (lazy; split per use)
         self._snap: Optional[Snapshot] = None
+        #: whether the most recent find_closest ran the t-sharded
+        #: resolve (round 13) — host scans and churn views reset it
+        self.last_resolve_sharded = False
         # in-flight background compaction: dispatched device arrays +
         # the mutation log to replay at swap (see _start_compaction)
         self._pending_base: Optional[dict] = None
@@ -791,7 +850,7 @@ class NodeTable:
 
     def find_closest(self, targets, *, k: int = TARGET_NODES,
                      now: Optional[float] = None, mask: str = "reachable",
-                     window: int = 128):
+                     window: int = 128, mesh=None):
         """k closest known peers for each target id
         (↔ RoutingTable::findClosestNodes, src/routing_table.cpp:109-150 —
         but batched over Q targets in one device call).
@@ -804,14 +863,28 @@ class NodeTable:
         compile; results are bit-identical to the device path (live ids
         are unique, so XOR distances never tie and the order is fully
         determined).  Large tables or big query waves go through
-        :meth:`view` (device snapshot / churn kernels).
+        :meth:`view` (device snapshot / churn kernels); a ``mesh``
+        (``config.resolve_mesh_t``) row-shards the snapshot resolve
+        over its ``t`` axis (:meth:`Snapshot.lookup`) — the churn view
+        and the host scan ignore it (identical results either way).
         """
         q = _as_limbs(targets)
         q = q.reshape(-1, IK.N_LIMBS)
+        # truth flag for the spans/counters upstream: whether THIS
+        # resolve actually ran the t-sharded kernel (the host scan and
+        # the churn view ignore mesh) — read by
+        # Dht.find_closest_nodes_batched right after the call, same
+        # thread (the DHT loop is single-threaded)
+        self.last_resolve_sharded = False
         if len(self) <= HOST_SCAN_MAX_ROWS \
                 and q.shape[0] <= HOST_SCAN_MAX_QUERIES:
             return self._find_closest_host(q, k, now, mask)
-        return self.view(now, mask=mask).lookup(q, k=k, window=window)
+        view = self.view(now, mask=mask)
+        if mesh is not None and mesh.shape.get("t", 1) > 1 \
+                and isinstance(view, Snapshot):
+            self.last_resolve_sharded = True
+            return view.lookup(q, k=k, window=window, mesh=mesh)
+        return view.lookup(q, k=k, window=window)
 
     def _find_closest_host(self, q: np.ndarray, k: int,
                            now: Optional[float], mask: str):

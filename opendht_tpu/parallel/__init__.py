@@ -1,5 +1,16 @@
-"""Multi-chip scale-out: sharded node tables + collective top-k merge."""
+"""Multi-chip scale-out: sharded node tables + collective top-k merge,
+placed by the declarative partition-rule layer (partition.py)."""
 
+from .partition import (  # noqa: F401
+    match_partition_rules,
+    make_shard_and_gather_fns,
+    shard_put,
+    constrain,
+    shard_table_state,
+    TableState,
+    TABLE_AXIS_RULES,
+    DP_AXIS_RULES,
+)
 from .sharded import (  # noqa: F401
     make_mesh,
     pad_to_multiple,
@@ -11,4 +22,5 @@ from .sharded import (  # noqa: F401
     sharded_maintenance_sweep,
     dp_simulate_lookups,
     tp_simulate_lookups,
+    build_tp_lookup,
 )

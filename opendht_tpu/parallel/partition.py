@@ -1,0 +1,295 @@
+"""Declarative sharding rules for mesh-placed DHT state.
+
+Large-model JAX codebases place parameter-sized state with one pattern
+(SNIPPETS.md retrieved three instances of it): a list of **regex
+partition rules** matched against the /-joined names of a state pytree
+yields a pytree of :class:`~jax.sharding.PartitionSpec`, which turns
+into per-leaf :class:`~jax.sharding.NamedSharding` **shard/gather
+functions** — host arrays go straight to their device slices (no
+replicated staging copy), device arrays reshard in place, and loop
+bodies pin intermediates with ``with_sharding_constraint``.  This
+module is that layer for the DHT's table state, replacing the
+hand-rolled per-entry ``jnp.asarray`` + ``device_put`` placement that
+``parallel/sharded.py`` grew one function at a time.
+
+The named state it exists for is :func:`shard_table_state`'s pytree —
+the row-sharded sorted table that scales the iterative search engine
+past one chip's HBM (ROADMAP item 1):
+
+``sorted_ids``   uint32 [N, 5]        ``P('t', None)`` — each ``t``
+                 shard owns one contiguous range of the global sorted
+                 order (the Kademlia analog: a node owns the contiguous
+                 XOR neighborhood around its id, PARITY.md).
+``local_lut``    int32 [n_t, 2^lb+1]  ``P('t', None)`` — per-shard
+                 positioning LUT over the shard's own rows, built once
+                 (the old layout re-derived it inside every launch).
+``block_lut``    int32 [2^bb+1]       replicated — the GLOBAL prefix
+                 LUT, assembled as ONE one-shot psum of the per-shard
+                 LUTs at table-build time.  Entry p of a shard's LUT is
+                 its local count of valid rows with prefix < p, and the
+                 global count is the sum, so the replicated table is
+                 bit-identical to ``build_prefix_lut`` over the whole
+                 id set.  This is what removes the per-hop block-edge
+                 psum from the engine's steady-state round: reply-block
+                 edges become two LOCAL reads, and the round's only
+                 collective is the reply-row merge
+                 (``sharded.build_tp_lookup``).
+``n_valid``      int32 scalar         replicated.
+
+Rules are matched first-hit in order; every leaf must match (the
+catch-all ``.*`` → replicated rule closes the list, as in the
+reference pattern).  Scalars and 0-d leaves never partition.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# jax moved shard_map out of experimental AND (separately, later)
+# renamed check_rep → check_vma; the two changes don't coincide, so the
+# kwarg is chosen by the resolved function's own signature rather than
+# by where it lives (a mid-window release has top-level jax.shard_map
+# that still takes check_rep).  Resolved once here; parallel/sharded.py
+# imports the resolved pair so every shard_map builder in the package
+# is version-agnostic.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:                                     # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map
+import inspect as _inspect
+try:
+    _sm_params = _inspect.signature(shard_map).parameters
+except (TypeError, ValueError):           # C-level/odd callables
+    _sm_params = {}
+SHARD_MAP_KW = ({"check_vma": False} if "check_vma" in _sm_params
+                else {"check_rep": False} if "check_rep" in _sm_params
+                else {})
+
+
+def tree_paths(tree):
+    """Pytree of '/'-joined string names, one per leaf (dict keys and
+    sequence indices), the name space the partition rules match."""
+    paths_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    names = []
+    for path, _leaf in paths_leaves:
+        parts = []
+        for entry in path:
+            key = getattr(entry, "key", getattr(entry, "idx",
+                                                getattr(entry, "name", None)))
+            parts.append(str(key))
+        names.append("/".join(parts))
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, names)
+
+
+def match_partition_rules(rules, tree):
+    """Pytree of PartitionSpec from ``rules``: an ordered list of
+    ``(regex, PartitionSpec)`` searched against each leaf's /-joined
+    name — the declarative placement pattern of large-model JAX
+    codebases (SNIPPETS.md).  Scalar leaves are never partitioned;
+    a leaf matching no rule is an error (close rule lists with
+    ``(".*", P())``)."""
+    def spec_of(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()                        # never partition scalars
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches leaf {name!r} "
+                         f"(shape {shape}) — add a rule or a catch-all")
+    return jax.tree_util.tree_map(spec_of, tree_paths(tree), tree)
+
+
+def make_shard_and_gather_fns(mesh: Mesh, partition_specs):
+    """Per-leaf (shard_fns, gather_fns) pytrees from a PartitionSpec
+    pytree.
+
+    A shard fn places ONE leaf under its NamedSharding: host (numpy)
+    arrays are ``device_put`` **directly to the sharding** — each
+    device receives only its slice, never a replicated staging copy
+    (the transient 2× HBM spike of ``jnp.asarray`` + re-placement that
+    ``dp_simulate_lookups`` used to pay); committed device arrays
+    reshard via a jitted identity pinned by ``out_shardings``.  A
+    gather fn is the inverse: one jitted identity to the fully
+    replicated spec, returned as numpy.
+    """
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), partition_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    is_ns = lambda x: isinstance(x, NamedSharding)     # noqa: E731
+    return (jax.tree_util.tree_map(_shard_fn_for, shardings, is_leaf=is_ns),
+            jax.tree_util.tree_map(_gather_fn_for, shardings, is_leaf=is_ns))
+
+
+@functools.lru_cache(maxsize=256)
+def _shard_fn_for(sharding: NamedSharding):
+    """Placement fn for one NamedSharding (memoized — repeated waves
+    reuse one compiled reshard identity per sharding)."""
+    @functools.partial(jax.jit, out_shardings=sharding)
+    def _reshard(x):
+        return jnp.asarray(x)
+
+    def shard_fn(x):
+        if getattr(x, "sharding", None) == sharding:
+            return x                          # already placed
+        if isinstance(x, (np.ndarray, np.generic)) or np.isscalar(x):
+            return jax.device_put(x, sharding)
+        return _reshard(x)
+    return shard_fn
+
+
+@functools.lru_cache(maxsize=256)
+def _gather_fn_for(sharding: NamedSharding):
+    rep = NamedSharding(sharding.mesh, P())
+
+    @functools.partial(jax.jit, out_shardings=rep)
+    def _gather(x):
+        return jnp.asarray(x)
+
+    def gather_fn(x):
+        return np.asarray(_gather(x))
+    return gather_fn
+
+
+def shard_put(mesh: Mesh, tree, rules):
+    """Place a whole named pytree by rule match — the one-call form the
+    ``parallel/sharded.py`` entry points use."""
+    specs = match_partition_rules(rules, tree)
+    shard_fns, _ = make_shard_and_gather_fns(mesh, specs)
+    return jax.tree_util.tree_map(lambda fn, x: fn(x), shard_fns, tree)
+
+
+def constrain(tree, mesh: Mesh, rules):
+    """``with_sharding_constraint`` every leaf of a named pytree to its
+    rule-matched spec — for use INSIDE jitted bodies (the dp engine's
+    query-axis pin), where placement is a compiler constraint rather
+    than a transfer."""
+    specs = match_partition_rules(rules, tree)
+    return jax.tree_util.tree_map(
+        lambda x, spec: lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec)),
+        tree, specs)
+
+
+# --------------------------------------------------------------------------
+# The DHT table-state rules.  First match wins; names are the keys of
+# the pytrees the parallel/ entry points build.
+# --------------------------------------------------------------------------
+
+#: row-sharded table state (the t axis owns rows; see module docstring)
+TABLE_AXIS_RULES = (
+    (r"sorted_ids$|^ids$|^table$|expanded$", P("t", None)),
+    (r"local_lut$", P("t", None)),
+    (r"block_lut$", P()),
+    (r"perm$|valid$|n_local$|last_reply$", P("t")),
+    (r"targets$|queries$", P("q", None)),
+    (r".*", P()),
+)
+
+#: data-parallel engine state (table replicated, queries over the
+#: whole mesh) — dp_simulate_lookups
+DP_AXIS_RULES = (
+    (r"targets$|queries$", P(("q", "t"), None)),
+    (r".*", P()),
+)
+
+
+class TableState(NamedTuple):
+    """A row-sharded sorted table, placed once and reused across waves
+    (:func:`shard_table_state`).  ``arrays`` is the named pytree whose
+    leaves sit under :data:`TABLE_AXIS_RULES`; the ints are the static
+    geometry ``sharded.build_tp_lookup`` compiles against."""
+    arrays: dict
+    shard_n: int
+    lut_bits: int
+    block_bits: int
+
+    @property
+    def sorted_ids(self):
+        return self.arrays["sorted_ids"]
+
+    def table_bytes_per_shard(self) -> int:
+        """Resident sorted-table bytes on ONE device — the N/t·5·4 B
+        figure the per-shard HBM budget bounds (benchmarks/
+        exp_shard_r13.py; ci/run_ci.sh asserts it on the 8-device
+        mesh)."""
+        return self.shard_n * self.sorted_ids.shape[1] * 4
+
+
+@functools.lru_cache(maxsize=16)
+def _build_state_luts(mesh: Mesh, shard_n: int, lut_bits: int,
+                      block_bits: int):
+    from ..ops.sorted_table import build_prefix_lut
+
+    def local(sorted_shard, n_valid):
+        ti = lax.axis_index("t")
+        n_local = jnp.clip(jnp.asarray(n_valid, jnp.int32)
+                           - ti.astype(jnp.int32) * shard_n, 0, shard_n)
+        lut = build_prefix_lut(sorted_shard, n_local, bits=lut_bits)
+        part = (lut if block_bits == lut_bits else
+                build_prefix_lut(sorted_shard, n_local, bits=block_bits))
+        # entry p of each shard's LUT counts LOCAL valid rows with
+        # prefix < p; the sum over shards is the global count — ONE
+        # one-shot psum yields the replicated global prefix LUT,
+        # bit-identical to build_prefix_lut over the whole table
+        block_lut = lax.psum(part, "t")
+        return lut[None], block_lut
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("t", None), P()),
+        out_specs=(P("t", None), P()),
+        **SHARD_MAP_KW,
+    )
+    return jax.jit(fn)
+
+
+def shard_table_state(mesh: Mesh, sorted_ids, n_valid, *,
+                      lut_bits: Optional[int] = None,
+                      block_bits: Optional[int] = None) -> TableState:
+    """Split a GLOBALLY sorted id table over the mesh ``t`` axis and
+    derive its lookup state — built ONCE per table, reused across every
+    wave (``tp_simulate_lookups(..., state=)``).
+
+    Row count must divide ``mesh.shape['t']`` (pad with invalid rows
+    via :func:`~opendht_tpu.parallel.sharded.pad_to_multiple`; pad rows
+    land on the LAST shard since padding appends past the valid
+    prefix).  Placement goes through :data:`TABLE_AXIS_RULES` — a host
+    array is sliced straight onto its owners.  ``lut_bits`` sizes the
+    per-shard positioning LUT (default ``default_lut_bits(shard_n)``);
+    ``block_bits`` the replicated global block LUT (default
+    ``default_lut_bits(N)`` — it must match the single-device engine's
+    width for bit-identity, core/search.py ``_lut_block_bounds``)."""
+    from ..ops.sorted_table import default_lut_bits
+    N = sorted_ids.shape[0]
+    n_t = mesh.shape["t"]
+    if N % n_t:
+        raise ValueError(f"table rows ({N}) not divisible by t={n_t}; "
+                         f"pad with invalid rows via pad_to_multiple")
+    shard_n = N // n_t
+    lb = lut_bits or default_lut_bits(shard_n)
+    bb = block_bits or default_lut_bits(N)
+    # normalize dtype BEFORE placement: the kernels are uint32-limb
+    # programs, and an int64 table silently produces wrong lookups
+    if hasattr(sorted_ids, "sharding"):
+        if sorted_ids.dtype != jnp.uint32:
+            sorted_ids = sorted_ids.astype(jnp.uint32)
+    else:
+        sorted_ids = np.asarray(sorted_ids, np.uint32)
+    placed = shard_put(mesh, {"sorted_ids": sorted_ids}, TABLE_AXIS_RULES)
+    nv = jnp.asarray(n_valid, jnp.int32)
+    local_lut, block_lut = _build_state_luts(mesh, shard_n, lb, bb)(
+        placed["sorted_ids"], nv)
+    return TableState(
+        arrays={"sorted_ids": placed["sorted_ids"], "local_lut": local_lut,
+                "block_lut": block_lut, "n_valid": nv},
+        shard_n=shard_n, lut_bits=lb, block_bits=bb)

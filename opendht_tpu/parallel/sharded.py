@@ -17,6 +17,20 @@ per-shard top-ks.  Collectives ride ICI when the mesh maps to one pod
 slice; nothing here assumes host locality, so the same code runs on a
 DCN-spanning mesh.
 
+Placement is DECLARATIVE (round 13): every entry point places its
+operands by regex partition rules over a named state pytree
+(``partition.match_partition_rules`` → per-leaf ``NamedSharding``
+shard fns, the standard large-model JAX pattern), and the iterative
+engine's table state — sorted rows, per-shard positioning LUT, the
+replicated global block LUT, validity — is built ONCE by
+``partition.shard_table_state`` and reused across waves.  Each ``t``
+shard holds ~N/t rows (plus the 4·2^bb-byte block LUT); nothing
+table-sized is replicated, so the servable id set scales linearly in
+mesh size.  The steady-state search round costs exactly ONE in-loop
+collective — the reply-row merge psum, O(queries·k) bytes — because
+reply-block edges read the replicated global LUT locally instead of
+psumming per-shard edge counts every hop (TP_SCALING.json).
+
 Compiled programs are cached per (mesh, k, tile/window, shard size) —
 repeated calls with the same geometry reuse one XLA executable.
 
@@ -37,24 +51,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# jax moved shard_map out of experimental AND (separately, later)
-# renamed check_rep → check_vma; the two changes don't coincide, so the
-# kwarg is chosen by the resolved function's own signature rather than
-# by where it lives (a mid-window release has top-level jax.shard_map
-# that still takes check_rep).  Resolved once so every builder below is
-# version-agnostic.
-if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
-else:                                     # jax <= 0.4.x
-    from jax.experimental.shard_map import shard_map as _shard_map
-import inspect as _inspect
-try:
-    _sm_params = _inspect.signature(_shard_map).parameters
-except (TypeError, ValueError):           # C-level/odd callables
-    _sm_params = {}
-_SM_KW = ({"check_vma": False} if "check_vma" in _sm_params
-          else {"check_rep": False} if "check_rep" in _sm_params
-          else {})
+# the shard_map version shim (experimental move + check_rep→check_vma
+# rename) lives with the declarative placement layer now; both names
+# are re-exported here for the callers that grew up against them
+from .partition import (shard_map as _shard_map, SHARD_MAP_KW as _SM_KW,
+                        TABLE_AXIS_RULES, DP_AXIS_RULES, TableState,
+                        shard_put, shard_table_state)
 
 from ..ops.ids import N_LIMBS
 from ..ops.xor_topk import xor_topk, select_topk, mask_invalid
@@ -101,6 +103,17 @@ def pad_to_multiple(arr: np.ndarray, m: int, axis: int = 0, fill=0):
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, pad)
     return np.pad(arr, widths, constant_values=fill), n
+
+
+def _as_operand(x, dtype=None):
+    """Normalize one entry-point operand for declarative placement:
+    host data becomes a (cast) numpy array — ``partition``'s shard fns
+    then ``device_put`` it straight to its shards, never a replicated
+    staging copy — while an already-committed jax array is cast in
+    place and resharded by the jitted identity."""
+    if hasattr(x, "sharding"):
+        return x if dtype is None or x.dtype == dtype else x.astype(dtype)
+    return np.asarray(x, dtype)
 
 
 def _gather_and_merge(dist, gidx, n_t, k):
@@ -150,8 +163,11 @@ def sharded_xor_topk(mesh: Mesh, queries, table, *, k: int = 8,
     if valid is None:
         valid = jnp.ones((N,), dtype=bool)
     fn = _build_sharded_xor_topk(mesh, k, min(tile, shard_n), shard_n)
-    return fn(jnp.asarray(queries, _U32), jnp.asarray(table, _U32),
-              jnp.asarray(valid))
+    ops = shard_put(mesh, {"queries": _as_operand(queries, np.uint32),
+                           "table": _as_operand(table, np.uint32),
+                           "valid": _as_operand(valid, bool)},
+                    TABLE_AXIS_RULES)
+    return fn(ops["queries"], ops["table"], ops["valid"])
 
 
 @functools.lru_cache(maxsize=8)
@@ -180,7 +196,10 @@ def sharded_sort_table(mesh: Mesh, table, valid=None):
     if valid is None:
         valid = jnp.ones((N,), dtype=bool)
     fn = _build_sharded_sort(mesh)
-    return fn(jnp.asarray(table, _U32), jnp.asarray(valid))
+    ops = shard_put(mesh, {"table": _as_operand(table, np.uint32),
+                           "valid": _as_operand(valid, bool)},
+                    TABLE_AXIS_RULES)
+    return fn(ops["table"], ops["valid"])
 
 
 @functools.lru_cache(maxsize=8)
@@ -289,9 +308,15 @@ def sharded_window_lookup(mesh: Mesh, queries, sorted_ids, perm, n_valid, *,
         lut = jnp.zeros((n_t, 2), jnp.int32)
     fn = _build_sharded_window_lookup(mesh, k, min(window, shard_n), shard_n,
                                       use_expanded)
-    return fn(jnp.asarray(queries, _U32), jnp.asarray(sorted_ids, _U32),
-              jnp.asarray(perm, jnp.int32), jnp.asarray(n_valid, jnp.int32),
-              jnp.asarray(expanded, _U32), jnp.asarray(lut, jnp.int32))
+    ops = shard_put(mesh, {"queries": _as_operand(queries, np.uint32),
+                           "sorted_ids": _as_operand(sorted_ids, np.uint32),
+                           "perm": _as_operand(perm, np.int32),
+                           "n_valid": _as_operand(n_valid, np.int32),
+                           "expanded": _as_operand(expanded, np.uint32),
+                           "local_lut": _as_operand(lut, np.int32)},
+                    TABLE_AXIS_RULES)
+    return fn(ops["queries"], ops["sorted_ids"], ops["perm"],
+              ops["n_valid"], ops["expanded"], ops["local_lut"])
 
 
 def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
@@ -308,58 +333,60 @@ def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
 @functools.lru_cache(maxsize=16)
 def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
                     alpha: int, search_nodes: int, max_hops: int,
-                    lut_bits: int, state_limbs: int = N_LIMBS,
-                    block_bits: int = 0):
+                    state_limbs: int = N_LIMBS):
     """Compile the table-sharded iterative lookup for one geometry.
 
-    Returns a jitted ``fn(sorted_ids, n_valid, targets, seed)`` whose
-    array inputs should be pre-placed (``sorted_ids`` P('t', None),
-    ``targets`` P('q', None)).  Public so honest benchmarks can wrap
-    the callable in a serialized rep chain (``bench.chain_slope``)
-    instead of wall-timing dispatches — :func:`tp_simulate_lookups` is
-    the convenience entry that places inputs per call.
+    Returns a jitted ``fn(sorted_ids, local_lut, block_lut, n_valid,
+    targets, seed)`` over the row-sharded table state a single
+    ``partition.shard_table_state`` call builds and places (sorted
+    rows + per-shard positioning LUT P('t', None), replicated global
+    block LUT, ``targets`` P('q', None)).  Public so honest benchmarks
+    can wrap the callable in a serialized rep chain
+    (``bench.chain_slope``) instead of wall-timing dispatches —
+    :func:`tp_simulate_lookups` is the convenience entry that builds
+    and places the state per call.
+
+    The steady-state round costs exactly ONE collective: the fused
+    reply-row merge psum (O(queries·k) bytes).  Reply-block edges —
+    one whole psum site per hop in the round-12 layout — are now two
+    LOCAL reads of the replicated global block LUT, which
+    ``shard_table_state`` assembled with a single one-shot psum of the
+    per-shard LUTs at table-build time (entry p of a shard's LUT is
+    its local count of valid rows with prefix < p; the sum over shards
+    is the global count, so the values are bit-identical to the
+    per-hop psum they replace).
     """
     q_local = q_total // mesh.shape["q"]
 
-    def local(sorted_shard, n_valid, targets_local, seed):
+    def local(sorted_shard, local_lut, block_lut, n_valid, targets_local,
+              seed):
         ti = lax.axis_index("t")
         base = (ti * shard_n).astype(jnp.int32)
         n = jnp.asarray(n_valid, jnp.int32)
         n_local = jnp.clip(n - base, 0, shard_n)
-        lut = build_prefix_lut(sorted_shard, n_local, bits=lut_bits)
-        local_lower = _guarded_lower_bound(sorted_shard, n_local, lut)
+        local_lower = _guarded_lower_bound(sorted_shard, n_local,
+                                           local_lut[0])
         sorted_t = sorted_shard.T                        # [5, shard_n]
 
         def lower(flat):
             # global lower bound = Σ_shards (local rows < q): each
             # shard's local lower-bound index IS that count, and the
             # global sorted order is the in-order concatenation of
-            # shard ranges — one [M]-int32 psum over the table axis
+            # shard ranges — one [M]-int32 psum over the table axis.
+            # Called ONCE per wave (the pre-loop target positioning),
+            # never inside the hop loop.
             return lax.psum(local_lower(flat), "t")
 
-        # reply-block edges as psum'd per-shard LUT reads: a count of
-        # local rows below a prefix is one LUT entry, and Σ shards =
-        # the global position — the same values _lut_block_bounds
-        # computes single-device (same `block_bits`), so tp results
-        # stay BIT-IDENTICAL while the per-round positioning search
-        # disappears (the round-5 engine win; exp_round_r5.py).
-        # The default derives from the GLOBAL table size, never the
-        # shard size: a shard-sized width would make the clamp depth —
-        # and hence the reply stream — vary with the mesh split,
-        # breaking the cross-mesh bit-identity tp_scaling.py asserts.
-        bb = block_bits or default_lut_bits(shard_n * mesh.shape["t"])
-        block_lut = (lut if bb == lut_bits else
-                     build_prefix_lut(sorted_shard, n_local, bits=bb))
-
         def block_bounds(t0, prefix_len):
-            # ONE stacked psum for both edges (round 6): summing the
-            # [2, ...] (lo, ub) pair in a single collective halves the
-            # in-loop all-reduce sites the block edges cost — addition
-            # is elementwise, so the stacked sum is bit-identical to
-            # two separate psums.
-            lo, ub = _lut_block_bounds(block_lut, t0, prefix_len)
-            s = lax.psum(jnp.stack([lo, ub]), "t")
-            return s[0], s[1]
+            # ZERO collectives: the block LUT is the replicated GLOBAL
+            # prefix LUT (built once per table — shard_table_state), so
+            # both edges are plain local gathers.  Values are the exact
+            # Σ-of-per-shard-counts the round-12 in-loop psum computed,
+            # hence bit-identical to the single-device engine at the
+            # same block width (default_lut_bits(N), never the shard
+            # size — a shard-sized width would make the clamp depth,
+            # and hence the reply stream, vary with the mesh split).
+            return _lut_block_bounds(block_lut, t0, prefix_len)
 
         def gather_planar(rows, limbs=N_LIMBS):
             # distributed row fetch: the owning shard contributes the
@@ -389,7 +416,7 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
 
     fn = _shard_map(
         local, mesh=mesh,
-        in_specs=(P("t", None), P(), P("q", None), P()),
+        in_specs=(P("t", None), P("t", None), P(), P(), P("q", None), P()),
         out_specs={"nodes": P("q", None), "dist": P("q", None, None),
                    "hops": P("q"), "converged": P("q")},
         **_SM_KW,
@@ -397,57 +424,70 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
     return jax.jit(fn)
 
 
-def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
-                        seed: int = 0, k: int = TARGET_NODES,
+def tp_simulate_lookups(mesh: Mesh, sorted_ids=None, n_valid=None,
+                        targets=None, *, seed: int = 0, k: int = TARGET_NODES,
                         alpha: int = ALPHA, search_nodes: int = SEARCH_NODES,
-                        max_hops: int = 48, state_limbs: int = N_LIMBS):
+                        max_hops: int = 48, state_limbs: int = N_LIMBS,
+                        state: "TableState | None" = None):
     """Iterative lookups with the sorted table ROW-SHARDED over ``t`` —
     the multi-chip north star: tables larger than one chip's HBM are
-    searched iteratively, not just scanned.
+    searched iteratively, not just scanned (10M+ ids spread across the
+    mesh, benchmarks/exp_shard_r13.py).
 
     ``sorted_ids`` must be GLOBALLY sorted (one :func:`sort_table` /
     host sort over the whole id set); each ``t``-shard then owns one
-    contiguous range of the global sorted order, which is what makes
-    both distributed primitives one-collective cheap:
+    contiguous range of the global sorted order — the Kademlia analog
+    of a node owning the contiguous XOR neighborhood around its id
+    (PARITY.md "t-sharded table").  That contiguity is what makes the
+    distributed primitives cheap:
 
-    - positioning: global lower_bound = psum of per-shard local counts;
-    - row fetch: owner-shard gather + psum (zeros elsewhere).
+    - positioning (once per wave): global lower_bound = ONE psum of
+      per-shard local counts;
+    - reply-block edges (per hop): two LOCAL reads of the replicated
+      global block LUT — ZERO collectives (see
+      :func:`build_tp_lookup`);
+    - row fetch (per hop): owner-shard gather + ONE psum — the round's
+      only in-loop collective, O(queries·k) bytes, never O(table).
 
-    Per hop a query moves ~(α+R)·5 u32 of id limbs and ~3·M int32 of
-    positions over ICI — O(queries), never O(table).  Search state is
-    sharded over ``q`` and replicated over ``t`` (deterministic
-    identical compute per t-rank, like the merge re-sort in
-    :func:`sharded_window_lookup`).  Results are BIT-IDENTICAL to
-    :func:`~opendht_tpu.core.search.simulate_lookups` on the same table
-    (the reply hash is seeded by global query identity) — asserted in
-    tests/test_sharded.py.
+    Search state is sharded over ``q`` and replicated over ``t``
+    (deterministic identical compute per t-rank, like the merge
+    re-sort in :func:`sharded_window_lookup`).  Results are
+    BIT-IDENTICAL to :func:`~opendht_tpu.core.search.simulate_lookups`
+    on the same table (the reply hash is seeded by global query
+    identity) — asserted in tests/test_sharded.py.
+
+    Callers serving a stable table should pass ``state=`` from
+    :func:`~opendht_tpu.parallel.partition.shard_table_state` (built
+    once, reused across waves — the sorted rows and positioning LUTs
+    then never re-place or re-derive per call); the raw
+    ``sorted_ids``/``n_valid`` form builds a state pytree on the fly.
 
     targets [Q, 5]: Q divisible by mesh.shape['q']; N divisible by
-    mesh.shape['t'].  Ref: the loop being scaled is searchStep,
+    mesh.shape['t'] (pad via :func:`pad_to_multiple` — pad rows land
+    on the LAST shard).  Ref: the loop being scaled is searchStep,
     /root/reference/src/dht.cpp:561-654.
     """
-    N = sorted_ids.shape[0]
-    n_t = mesh.shape["t"]
-    if N % n_t:
-        raise ValueError(f"table rows ({N}) not divisible by t={n_t}; "
-                         f"pad with invalid rows via pad_to_multiple")
+    if state is None:
+        if sorted_ids is None or n_valid is None:
+            raise ValueError("pass either (sorted_ids, n_valid) or state=")
+        state = shard_table_state(mesh, sorted_ids, n_valid)
+    if targets is None:
+        raise ValueError("targets are required")
     Q = targets.shape[0]
     if Q % mesh.shape["q"]:
         raise ValueError(f"targets ({Q}) not divisible by q axis "
                          f"{mesh.shape['q']}")
-    shard_n = N // n_t
-    fn = build_tp_lookup(mesh, shard_n, Q, k, alpha, search_nodes, max_hops,
-                         default_lut_bits(shard_n), state_limbs,
-                         block_bits=default_lut_bits(N))
-    sorted_ids = jax.device_put(jnp.asarray(sorted_ids, _U32),
-                                NamedSharding(mesh, P("t", None)))
-    targets = jax.device_put(jnp.asarray(targets, _U32),
-                             NamedSharding(mesh, P("q", None)))
+    fn = build_tp_lookup(mesh, state.shard_n, Q, k, alpha, search_nodes,
+                         max_hops, state_limbs)
+    targets = shard_put(mesh, {"targets": _as_operand(targets, np.uint32)},
+                        TABLE_AXIS_RULES)["targets"]
+    a = state.arrays
+    args = (a["sorted_ids"], a["local_lut"], a["block_lut"], a["n_valid"],
+            targets, jnp.asarray(seed, jnp.int32))
     from .. import telemetry
     reg = telemetry.get_registry()
     if not reg.enabled:
-        return fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
-                  jnp.asarray(seed, jnp.int32))
+        return fn(*args)
     # same host-side envelope as the single-device entry (core/search.py
     # simulate_lookups): the traced computation is untouched, the span
     # blocks and the wave/hops series land under mode="tp" — and via
@@ -455,11 +495,11 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, *,
     # spans too (ISSUE-4), so a sharded lookup shows up in the same
     # Chrome/Perfetto timeline as the single-device one
     with reg.span("dht_search_wave_seconds", record=False) as sp:
-        out = fn(sorted_ids, jnp.asarray(n_valid, jnp.int32), targets,
-                 jnp.asarray(seed, jnp.int32))
+        out = fn(*args)
         jax.block_until_ready(out)
     from ..core.search import record_wave
-    record_wave(out, sp.elapsed, Q, mode="tp")
+    record_wave(out, sp.elapsed, Q, mode="tp",
+                mesh_t=mesh.shape["t"])
     return out
 
 
@@ -518,15 +558,32 @@ def sharded_maintenance_sweep(mesh: Mesh, self_id, ids, valid, last_reply,
     if valid is None:
         valid = jnp.ones((N,), bool)
     fn = _build_sharded_maintenance(mesh)
+    ops = shard_put(mesh, {"ids": _as_operand(ids, np.uint32),
+                           "valid": _as_operand(valid, bool),
+                           "last_reply": _as_operand(last_reply, np.float32)},
+                    TABLE_AXIS_RULES)
     from .. import telemetry
     reg = telemetry.get_registry()
     reg.counter("dht_maintenance_sweeps_total", mode="tp").inc()
     with reg.span("dht_maintenance_sweep_seconds", mode="tp"):
-        out = fn(jnp.asarray(self_id, _U32), jnp.asarray(ids, _U32),
-                 jnp.asarray(valid), jnp.asarray(last_reply),
-                 jnp.asarray(now), jnp.asarray(age), key)
+        out = fn(jnp.asarray(self_id, _U32), ops["ids"], ops["valid"],
+                 ops["last_reply"], jnp.asarray(now), jnp.asarray(age), key)
         jax.block_until_ready(out)
     return out
+
+
+@functools.lru_cache(maxsize=8)
+def _dp_lut_builder(mesh: Mesh, bits: int):
+    """Build the dp engine's prefix LUT FROM THE PLACED (replicated)
+    table, with the output pinned replicated by
+    ``with_sharding_constraint`` — no default-device build followed by
+    a re-placement copy."""
+    rep = NamedSharding(mesh, P(None))
+
+    def fn(sorted_ids, n_valid):
+        lut = build_prefix_lut(sorted_ids, n_valid, bits=bits)
+        return lax.with_sharding_constraint(lut, rep)
+    return jax.jit(fn)
 
 
 def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):
@@ -534,14 +591,26 @@ def dp_simulate_lookups(mesh: Mesh, sorted_ids, n_valid, targets, **kw):
     whole mesh (both axes), sorted table replicated.  The per-step merge
     sort, window binary search, and while_loop all partition trivially
     along the query axis — XLA inserts no cross-device collectives in
-    steady state, so scaling is linear in chips."""
-    q_sharding = NamedSharding(mesh, P(("q", "t"), None))
-    rep = NamedSharding(mesh, P(None, None))
-    targets = jax.device_put(jnp.asarray(targets, _U32), q_sharding)
-    sorted_ids = jax.device_put(jnp.asarray(sorted_ids, _U32), rep)
+    steady state, so scaling is linear in chips.
+
+    Placement goes through the declarative rule layer
+    (``partition.DP_AXIS_RULES``): a host table is ``device_put``
+    straight to its replicated sharding — the old ``jnp.asarray`` +
+    re-place sequence staged a full extra copy on the default device
+    first, a transient 2× HBM spike at exactly the table sizes this
+    path serves.  Callers with a stable table should pass ``lut=``
+    (built once via ``ops.sorted_table.build_prefix_lut``) so repeated
+    waves skip the rebuild; when absent the LUT is derived from the
+    PLACED table under one jit whose output is constrained replicated,
+    never built on the default device and copied."""
+    placed = shard_put(mesh, {"targets": _as_operand(targets, np.uint32),
+                              "sorted_ids": _as_operand(sorted_ids,
+                                                        np.uint32)},
+                       DP_AXIS_RULES)
+    targets = placed["targets"]
+    sorted_ids = placed["sorted_ids"]
     if kw.get("lut") is None:
-        kw["lut"] = jax.device_put(
-            build_prefix_lut(sorted_ids, jnp.asarray(n_valid, jnp.int32),
-                             bits=default_lut_bits(sorted_ids.shape[0])),
-            NamedSharding(mesh, P(None)))
+        kw["lut"] = _dp_lut_builder(
+            mesh, default_lut_bits(sorted_ids.shape[0]))(
+                sorted_ids, jnp.asarray(n_valid, jnp.int32))
     return simulate_lookups(sorted_ids, n_valid, targets, **kw)

@@ -60,7 +60,7 @@ from typing import Dict, List, Optional
 
 __all__ = [
     "KERNEL_SPECS", "PLATFORM_PEAKS", "KernelLedger", "get_ledger",
-    "ledger_computed", "maybe_export", "wave_attrs",
+    "ledger_computed", "maybe_export", "wave_attrs", "ingest_wave_attrs",
 ]
 
 # --------------------------------------------------------------------------
@@ -311,23 +311,59 @@ def _spec_tp_simulate_lookups():
     8-device step runs, lowered at the smallest geometry so the budget
     is computable on any host.  Collective sites still appear in the
     lowering (psum over a 1-ary axis), so a refactor that adds an
-    in-loop collective moves this entry."""
+    in-loop collective moves this entry.  Round 13: the operands are
+    the row-sharded table state a ``partition.shard_table_state`` call
+    builds ONCE — sorted rows, per-shard positioning LUT, replicated
+    global block LUT — so ``argument_bytes`` now pins the per-device
+    resident footprint of the canonical t-sharded lookup (table bytes
+    = N/t·5·4 B per shard; a refactor that re-replicates rows or moves
+    a LUT rebuild back into the launch moves this entry's
+    argument_bytes/bytes_accessed and fails the gate)."""
     from jax.sharding import Mesh
     import numpy as np
     import jax
-    from .ops.sorted_table import default_lut_bits
+    from .parallel.partition import shard_table_state
     from .parallel.sharded import build_tp_lookup
     import jax.numpy as jnp
     s, _e, nv, _lut = _canonical_table(_CANON["N"])
     t = _queries(_CANON["W"], seed=20)
     mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("q", "t"))
-    fn = build_tp_lookup(mesh, _CANON["N"], _CANON["W"], _CANON["K"], 3,
-                         14, 48, default_lut_bits(_CANON["N"]),
-                         state_limbs=2,
-                         block_bits=default_lut_bits(_CANON["N"]))
-    return (fn, (s, jnp.asarray(nv, jnp.int32), t, jnp.int32(0)), {},
+    state = shard_table_state(mesh, s, nv)
+    fn = build_tp_lookup(mesh, state.shard_n, _CANON["W"], _CANON["K"], 3,
+                         14, 48, state_limbs=2)
+    a = state.arrays
+    return (fn, (a["sorted_ids"], a["local_lut"], a["block_lut"],
+                 a["n_valid"], t, jnp.int32(0)), {},
             {"N": _CANON["N"], "W": _CANON["W"], "mesh": "1x1",
-             "k": _CANON["K"], "state_limbs": 2})
+             "k": _CANON["K"], "state_limbs": 2,
+             "layout": "row-sharded-state"})
+
+
+def _spec_sharded_window_lookup():
+    """The per-shard windowed top-k + ONE cross-shard merge kernel
+    (parallel/sharded.py sharded_window_lookup, round-13 declarative
+    layout) on a 1×1 mesh — the one-shot resolve path the ingest wave
+    builder launches when a resolve mesh is configured
+    (runtime/config.py resolve_mesh_t)."""
+    from jax.sharding import Mesh
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .ops.sorted_table import _EROW
+    from .ops.ids import N_LIMBS
+    from .parallel.sharded import _build_sharded_window_lookup
+    s, _e, nv, _lut = _canonical_table(_CANON["N"])
+    q = _queries(_CANON["Q"], seed=25)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("q", "t"))
+    fn = _build_sharded_window_lookup(mesh, _CANON["K"], 128, _CANON["N"],
+                                      False)
+    perm = jnp.arange(_CANON["N"], dtype=jnp.int32)
+    expanded = jnp.zeros((1, N_LIMBS * _EROW), jnp.uint32)
+    lut = jnp.zeros((1, 2), jnp.int32)
+    return (fn, (q, s, perm, jnp.asarray(nv, jnp.int32)[None], expanded,
+                 lut), {},
+            {"N": _CANON["N"], "Q": _CANON["Q"], "k": _CANON["K"],
+             "mesh": "1x1", "window": 128})
 
 
 def _spec_sharded_maintenance():
@@ -366,6 +402,8 @@ KERNEL_SPECS = {
         _spec_simulate_lookups, 'dht_search_wave_seconds{mode="single"}'),
     "tp_simulate_lookups": (
         _spec_tp_simulate_lookups, 'dht_search_wave_seconds{mode="tp"}'),
+    "sharded_window_lookup": (
+        _spec_sharded_window_lookup, None),
     "sharded_maintenance_sweep": (
         _spec_sharded_maintenance,
         'dht_maintenance_sweep_seconds{mode="tp"}'),
@@ -602,7 +640,7 @@ class KernelLedger:
 
     # ----------------------------------------------------- trace-span hook
     def wave_cost(self, wave_width: int, rounds: int,
-                  mode: str = "single") -> dict:
+                  mode: str = "single", mesh_t: int = 1) -> dict:
         """Cost-model estimate for one LIVE wave, scaled from the
         matching canonical engine entry — ``simulate_lookups`` for
         single-device waves, ``tp_simulate_lookups`` (the shard_map
@@ -624,11 +662,43 @@ class KernelLedger:
             return {}
         w_c = entry["shape"]["W"]
         scale = (wave_width / float(w_c)) * rounds
-        return {
-            "est_device_bytes": int(entry["bytes_accessed"] * scale),
-            "est_device_flops": int(entry["flops"] * scale),
+        # t-sharded waves (round 13): the canonical tp entry lowers on
+        # a 1x1 mesh, so its table traffic is whole-table; on a real
+        # t-way split each device scans ~1/t of the rows, so the
+        # PER-DEVICE estimate divides by mesh_t.  Approximate by
+        # construction (the O(queries·k) collective bytes don't divide)
+        # and labeled as such in the cost_model string.
+        t = max(1, int(mesh_t))
+        attrs = {
+            "est_device_bytes": int(entry["bytes_accessed"] * scale / t),
+            "est_device_flops": int(entry["flops"] * scale / t),
             "cost_model": "%s xla-body-once x width/%d x rounds"
                           % (src, w_c),
+        }
+        if t > 1:
+            attrs["cost_model"] += " / t=%d (row-sharded)" % t
+            attrs["table_shard_t"] = t
+        return attrs
+
+    def ingest_wave_cost(self, occupancy: int, mesh_t: int = 1) -> dict:
+        """Cost-model estimate for one LIVE ingest wave, scaled from
+        the canonical coalesced-launch entry (``wave_builder_lookup``)
+        by occupancy, with per-device table traffic divided by
+        ``mesh_t`` when the resolve actually ran against the t-sharded
+        table (round 13).  Approximate by construction (the
+        cross-shard merge bytes don't divide); same entry-access
+        discipline as :meth:`wave_cost` — pure dict math, safe on the
+        wave-scatter path."""
+        entry = self._entries.get("wave_builder_lookup")
+        if not entry or "error" in entry:
+            return {}
+        t = max(1, int(mesh_t))
+        scale = occupancy / float(entry["shape"]["Q"]) / t
+        return {
+            "est_device_bytes": int(entry["bytes_accessed"] * scale),
+            "cost_model": "wave_builder_lookup x occupancy/%d%s"
+                          % (entry["shape"]["Q"],
+                             " / t=%d (row-sharded)" % t if t > 1 else ""),
         }
 
 
@@ -661,18 +731,30 @@ def maybe_export(reg=None) -> int:
         return 0
 
 
+def ingest_wave_attrs(occupancy: int, mesh_t: int = 1) -> dict:
+    """Device-cost attributes for an ingest ``dht.search.wave`` span
+    (runtime/wave_builder.py) — thin module-level hook over
+    :meth:`KernelLedger.ingest_wave_cost`, gated exactly like
+    :func:`wave_attrs`: empty dict (a cached-flag check) until the
+    ledger is computed."""
+    if not _ledger.computed():
+        return {}
+    return _ledger.ingest_wave_cost(occupancy, mesh_t)
+
+
 def wave_attrs(wave_width: int, rounds: int, elapsed_s: float,
-               mode: str = "single") -> dict:
+               mode: str = "single", mesh_t: int = 1) -> dict:
     """Device-cost attributes for a ``dht.search.wave`` trace span
-    (core/search.py record_wave; the tp twin passes ``mode="tp"`` so
-    the estimate comes from the sharded program's entry): the scaled
-    cost-model estimate plus the achieved HBM fraction over the
+    (core/search.py record_wave; the tp twin passes ``mode="tp"`` and
+    its mesh's ``t`` extent so the estimate comes from the sharded
+    program's entry with per-device table traffic scaled by 1/t): the
+    scaled cost-model estimate plus the achieved HBM fraction over the
     platform peak when the wave's host-measured elapsed is known.
     Empty dict (and ~zero cost) until someone computes the ledger —
     the hot path only ever pays a dict lookup."""
     if not _ledger.computed():
         return {}
-    attrs = _ledger.wave_cost(wave_width, rounds, mode)
+    attrs = _ledger.wave_cost(wave_width, rounds, mode, mesh_t)
     if attrs and elapsed_s > 0:
         try:
             peaks = platform_peaks()

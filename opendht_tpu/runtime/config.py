@@ -76,6 +76,17 @@ class Config:
     #: quotas use); 0 = unlimited
     ingest_admit_per_sec: int = 0
 
+    # --- t-sharded resolve (round 13, parallel/partition.py) ----------
+    #: row-shard the device-side closest-node resolve over a t-wide
+    #: mesh axis: ingest waves (and any other big-batch find_closest)
+    #: run the per-shard windowed top-k + one cross-shard merge instead
+    #: of the single-device kernel, so the servable table scales past
+    #: one chip's HBM.  0/1 = unsharded (the default single-device
+    #: path); >= 2 requires that many jax devices (falls back to
+    #: unsharded with a logged warning when the host has fewer).
+    #: Results are bit-identical either way (tests/test_sharded.py).
+    resolve_mesh_t: int = 0
+
 
 @dataclass
 class SecureDhtConfig:

@@ -181,6 +181,15 @@ class Dht:
         # (wave_builder.py; config.ingest_* knobs)
         self.wave_builder = WaveBuilder(self, config)
 
+        # t-sharded resolve (round 13): lazily-built (q=1, t) mesh from
+        # config.resolve_mesh_t; None until first use, False = probed
+        # and unavailable (fewer devices than requested / no jax).
+        # last_resolve_shard_t records what the MOST RECENT batched
+        # resolve actually used (1 when the host scan / churn view
+        # served it regardless of config).
+        self._resolve_mesh = None
+        self.last_resolve_shard_t = 1
+
         # maintenance telemetry (ISSUE-5): handles cached once
         _reg = telemetry.get_registry()
         self._m_maint_sweeps = _reg.counter("dht_maintenance_sweeps_total")
@@ -253,17 +262,62 @@ class Dht:
         one row of the batched device kernel)."""
         return self.find_closest_nodes_batched([target], af, count)[0]
 
+    def resolve_mesh(self):
+        """The (q=1, t) device mesh batched resolves row-shard over
+        when ``config.resolve_mesh_t >= 2`` (round 13) — built once,
+        ``None`` when unconfigured or when the host has fewer devices
+        than requested (logged; serving degrades to the identical
+        unsharded path, never fails)."""
+        t = int(getattr(self.config, "resolve_mesh_t", 0) or 0)
+        if t <= 1:
+            return None
+        if self._resolve_mesh is None:
+            try:
+                import jax
+                from ..parallel import make_mesh
+                if len(jax.devices()) < t:
+                    log.warning(
+                        "resolve_mesh_t=%d but only %d jax device(s); "
+                        "serving the unsharded resolve path",
+                        t, len(jax.devices()))
+                    self._resolve_mesh = False
+                else:
+                    self._resolve_mesh = make_mesh(t, q=1, t=t)
+            except Exception:
+                log.exception("resolve mesh unavailable; serving unsharded")
+                self._resolve_mesh = False
+        return self._resolve_mesh or None
+
+    def resolve_mesh_t(self) -> int:
+        """Active resolve-shard width (1 = unsharded) — the ingest wave
+        builder stamps this on its wave spans/snapshot."""
+        m = self.resolve_mesh()
+        return int(m.shape["t"]) if m is not None else 1
+
     def find_closest_nodes_batched(self, targets: List[InfoHash], af: int,
                                    count: int = TARGET_NODES
                                    ) -> List[List[Node]]:
         """Batched form: resolve *many* targets with one device top-k
         call — the core TPU win for nodes serving thousands of concurrent
-        requests (SURVEY.md §7 design mapping)."""
+        requests (SURVEY.md §7 design mapping).  With a configured
+        resolve mesh the device call is the t-sharded per-shard top-k +
+        one cross-shard merge (core/table.py Snapshot.lookup)."""
+        # reset BEFORE any early return: a wave served by an empty
+        # table (or one whose launch raises) must not inherit the
+        # previous resolve's shard width (review finding)
+        self.last_resolve_shard_t = 1
         table = self._table(af)
         if table is None or len(table) == 0 or not targets:
             return [[] for _ in targets]
         now = self.scheduler.time()
-        rows, _dist = table.find_closest(list(targets), k=count, now=now)
+        rows, _dist = table.find_closest(list(targets), k=count, now=now,
+                                         mesh=self.resolve_mesh())
+        # truth, not config: the table says whether THIS resolve ran
+        # sharded (host scans and churn views ignore the mesh) — the
+        # ingest wave spans/counters attribute from this flag
+        self.last_resolve_shard_t = (
+            self.resolve_mesh_t()
+            if getattr(table, "last_resolve_sharded", False) else 1)
         # one vectorized id conversion for the whole result matrix — the
         # per-row numpy round-trip dominated big batches (table.py
         # ids_of_rows)

@@ -130,6 +130,10 @@ class WaveBuilder:
         self._m_occupancy = reg.histogram("dht_ingest_wave_occupancy")
         self._m_queue_s = reg.histogram("dht_ingest_queue_seconds")
         self._m_waves = reg.counter("dht_ingest_waves_total")
+        # round 13: waves whose resolve ran against the t-sharded table
+        # (config.resolve_mesh_t) — the occupancy/latency histograms
+        # above cover both modes; this counter says which mode served
+        self._m_sharded_waves = reg.counter("dht_ingest_sharded_waves_total")
         self._m_ops = {}              # kind -> counter (cached handles)
         self._m_sheds = {}            # reason -> counter
 
@@ -279,6 +283,13 @@ class WaveBuilder:
         self._m_occupancy.observe(len(entries))
         for e in entries:
             self._m_queue_s.observe(max(0.0, t_fire - e.t_wall))
+        # truth, not config: what the resolve ACTUALLY used — a wave
+        # served by the host scan or the churn view reports t=1 even
+        # when a resolve mesh is configured (Dht sets this right after
+        # the table call, same thread)
+        shard_t = int(getattr(self._dht, "last_resolve_shard_t", 1) or 1)
+        if shard_t > 1:
+            self._m_sharded_waves.inc()
 
         # ISSUE-4 spine: one dht.search.wave span per launch (the
         # ingest-mode sibling of the engine's wave span), each carried
@@ -290,9 +301,17 @@ class WaveBuilder:
         wave_ctx = None
         wave_end = t_fire + sp.elapsed
         if tr.enabled and any(e.ctx is not None for e in entries):
+            # round 13: device-cost attrs from the ledger's canonical
+            # coalesced-launch entry, with per-device table traffic
+            # scaled by 1/t when the resolve ran row-sharded (empty
+            # dict until the ledger is computed — a dict lookup on the
+            # hot path, same discipline as record_wave's wave_attrs)
+            from .. import profiling
+            cost = profiling.ingest_wave_attrs(len(entries), shard_t)
             wave_ctx = tr.record(
                 "dht.search.wave", t_fire, sp.elapsed,
-                mode="ingest", occupancy=len(entries), af=af, k=k)
+                mode="ingest", occupancy=len(entries), af=af, k=k,
+                table_shard_t=shard_t, **cost)
         for e, nodes in zip(entries, results):
             if wave_ctx is not None and e.ctx is not None:
                 # span covers submit → scatter, anchored on the entry's
@@ -315,8 +334,14 @@ class WaveBuilder:
         occ = self._m_occupancy
         qs = self._m_queue_s
         mean_occ = (occ.sum / occ.count) if occ.count else 0.0
+        try:
+            shard_t = self._dht.resolve_mesh_t()
+        except Exception:
+            shard_t = 1
         return {
             "batching": "on" if self.enabled else "off",
+            "table_shard_t": shard_t,
+            "sharded_waves": int(self._m_sharded_waves.value),
             "fill_target": self.fill_target,
             "deadline_s": self.deadline,
             "queue_depth": len(self._pending),

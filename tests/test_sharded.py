@@ -253,6 +253,204 @@ def test_sharded_maintenance_sweep_matches_single_device(mesh):
                                       err_msg=name)
 
 
+# ---------------------------------------------------------------------------
+# Round 13: declarative partition layer + row-sharded geometry sweep
+# ---------------------------------------------------------------------------
+
+def test_match_partition_rules_names_and_scalars():
+    """Rule matching follows /-joined leaf names, first hit wins, and
+    scalar leaves never partition regardless of rule."""
+    from jax.sharding import PartitionSpec as P
+    from opendht_tpu.parallel import partition
+
+    tree = {"sorted_ids": np.zeros((8, 5), np.uint32),
+            "local_lut": np.zeros((2, 9), np.int32),
+            "block_lut": np.zeros((17,), np.int32),
+            "n_valid": np.int32(7),
+            "nested": {"targets": np.zeros((4, 5), np.uint32)}}
+    specs = partition.match_partition_rules(partition.TABLE_AXIS_RULES, tree)
+    assert specs["sorted_ids"] == P("t", None)
+    assert specs["local_lut"] == P("t", None)
+    assert specs["block_lut"] == P()
+    assert specs["n_valid"] == P()               # scalar guard
+    assert specs["nested"]["targets"] == P("q", None)
+    with pytest.raises(ValueError, match="no partition rule"):
+        partition.match_partition_rules(
+            [(r"^only_this$", P("t"))], {"other": np.zeros((4,))})
+
+
+def test_shard_and_gather_fns_roundtrip(mesh):
+    """shard fn places a host array straight onto its shards (per-device
+    bytes = N/t rows — the whole point of the layout); gather fn
+    returns the exact original."""
+    from opendht_tpu.parallel import partition
+
+    rng = np.random.default_rng(70)
+    tree = {"sorted_ids": _rand_ids(rng, 64 * mesh.shape["t"])}
+    specs = partition.match_partition_rules(partition.TABLE_AXIS_RULES, tree)
+    shard_fns, gather_fns = partition.make_shard_and_gather_fns(mesh, specs)
+    placed = shard_fns["sorted_ids"](tree["sorted_ids"])
+    shard = placed.addressable_shards[0].data
+    assert shard.shape[0] == 64 * mesh.shape["t"] // mesh.shape["t"]
+    assert shard.nbytes == placed.nbytes // mesh.shape["t"]
+    np.testing.assert_array_equal(gather_fns["sorted_ids"](placed),
+                                  tree["sorted_ids"])
+    # placement is idempotent: re-sharding an already-placed array is
+    # the identity (the Snapshot resolve cache depends on this)
+    assert shard_fns["sorted_ids"](placed) is placed
+
+
+def test_shard_table_state_block_lut_is_global(mesh):
+    """The replicated block LUT assembled from per-shard psums must
+    equal build_prefix_lut over the whole table — the bit-identity
+    basis for the zero-collective in-loop block edges."""
+    from opendht_tpu.ops.sorted_table import build_prefix_lut
+    from opendht_tpu.parallel import shard_table_state
+
+    rng = np.random.default_rng(71)
+    ids = _rand_ids(rng, 2048)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    state = shard_table_state(mesh, np.asarray(sorted_ids), n_valid)
+    ref = build_prefix_lut(sorted_ids, jnp.asarray(n_valid, jnp.int32),
+                           bits=state.block_bits)
+    np.testing.assert_array_equal(np.asarray(state.arrays["block_lut"]),
+                                  np.asarray(ref))
+    assert state.table_bytes_per_shard() == 2048 // mesh.shape["t"] * 20
+
+
+def test_shard_table_state_casts_dtype(mesh):
+    """A non-uint32 id table must be cast before placement — the limb
+    kernels silently mis-rank on int64 otherwise (review finding)."""
+    rng = np.random.default_rng(74)
+    ids = _rand_ids(rng, 1024)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = _rand_ids(rng, 8 * mesh.shape["q"])
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=7)
+    out = tp_simulate_lookups(mesh, np.asarray(sorted_ids).astype(np.int64),
+                              n_valid, targets, seed=7)
+    for key in ("nodes", "hops", "converged"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]))
+
+
+def test_tp_simulate_with_prebuilt_state(mesh):
+    """The state= fast path (table placed once, reused across waves)
+    returns exactly what the raw-array path returns."""
+    from opendht_tpu.parallel import shard_table_state
+
+    rng = np.random.default_rng(72)
+    ids = _rand_ids(rng, 2048)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = _rand_ids(rng, 8 * mesh.shape["q"])
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=9)
+    state = shard_table_state(mesh, np.asarray(sorted_ids), n_valid)
+    for _ in range(2):                    # second wave reuses everything
+        out = tp_simulate_lookups(mesh, targets=targets, seed=9, state=state)
+        for key in ("nodes", "hops", "converged", "dist"):
+            np.testing.assert_array_equal(np.asarray(out[key]),
+                                          np.asarray(ref[key]))
+
+
+@pytest.mark.parametrize("q,t", [(1, 2), (2, 2), (1, 4), (4, 1)])
+def test_row_sharded_geometry_sweep(q, t):
+    """ISSUE-8 satellite: every entry point — iterative lookup,
+    window-lookup, xor-topk, maintenance sweep — pinned bit-identical
+    to single-device across q×t splits on the ROW-SHARDED table,
+    including ragged N (pad rows land on the last shard) and an
+    ALL-INVALID shard."""
+    if len(jax.devices()) < q * t:
+        pytest.skip(f"needs {q * t} virtual devices")
+    from opendht_tpu.ops import radix
+    m = make_mesh(q * t, q=q, t=t)
+    rng = np.random.default_rng(60 + 4 * q + t)
+    N_ragged = 1021                       # prime → real padding
+    ids = _rand_ids(rng, N_ragged)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    padded, _ = pad_to_multiple(np.asarray(sorted_ids), t * 4)
+    targets = _rand_ids(rng, 8 * q)
+
+    # iterative engine on the ragged row-sharded table
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=8)
+    out = tp_simulate_lookups(m, padded, n_valid, targets, seed=8)
+    for key in ("nodes", "hops", "converged"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]), err_msg=key)
+
+    # full-scan + window top-k with an entirely invalid shard: valid
+    # rows only in the first global quarter, so on t=4 the later
+    # shards hold zero valid rows
+    table = _rand_ids(rng, 64 * t * 4)
+    valid = np.zeros(table.shape[0], bool)
+    valid[:table.shape[0] // 4] = True
+    queries = _rand_ids(rng, 8 * q)
+    d_ref, i_ref = xor_topk(jnp.asarray(queries), jnp.asarray(table), k=8,
+                            valid=jnp.asarray(valid))
+    d_sh, i_sh = sharded_xor_topk(m, queries, table, k=8,
+                                  valid=jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(i_sh), np.asarray(i_ref))
+    np.testing.assert_array_equal(np.asarray(d_sh), np.asarray(d_ref))
+    d_w, rows_w = sharded_lookup(m, queries, table, k=8, window=32,
+                                 valid=jnp.asarray(valid))
+    np.testing.assert_array_equal(np.asarray(rows_w), np.asarray(i_ref))
+    np.testing.assert_array_equal(np.asarray(d_w), np.asarray(d_ref))
+
+    # maintenance sweep on the same all-invalid-shard layout
+    self_id = _rand_ids(rng, 1).reshape(-1)
+    last = rng.uniform(1.0, 100.0, table.shape[0]).astype(np.float32)
+    key = jax.random.PRNGKey(31)
+    ref_m = radix.maintenance_sweep(
+        jnp.asarray(self_id), jnp.asarray(table), jnp.asarray(valid),
+        jnp.asarray(last), 700.0, 600.0, key)
+    got_m = sharded_maintenance_sweep(m, self_id, table, valid, last,
+                                      700.0, 600.0, key)
+    for a, b, name in zip(got_m, ref_m, ("counts", "last", "stale",
+                                         "targets")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
+def test_snapshot_lookup_sharded_matches_unsharded(mesh):
+    """The t-sharded snapshot resolve (config.resolve_mesh_t wiring,
+    core/table.py Snapshot.lookup mesh=) returns exactly the
+    single-device resolve — rows and distances."""
+    from opendht_tpu.core.table import NodeTable
+    from opendht_tpu.infohash import InfoHash
+
+    rng = np.random.default_rng(73)
+    nt = NodeTable(InfoHash.get_random(), capacity=512)
+    now = 100.0
+    for i in range(300):
+        nt.insert(InfoHash.get_random(), ("10.0.0.%d" % (i % 250), 4222),
+                  now=now, confirm=2)
+    snap = nt.snapshot(now)
+    q = _rand_ids(rng, 16)
+    rows_ref, dist_ref = snap.lookup(q, k=8)
+    rows_sh, dist_sh = snap.lookup(q, k=8, mesh=mesh)
+    np.testing.assert_array_equal(rows_sh, rows_ref)
+    np.testing.assert_array_equal(dist_sh, dist_ref)
+    # second call reuses the cached placed shards (no re-pad, no copy)
+    rows_sh2, _ = snap.lookup(q, k=8, mesh=mesh)
+    np.testing.assert_array_equal(rows_sh2, rows_ref)
+
+
+def test_dht_resolve_mesh_knob(mesh):
+    """config.resolve_mesh_t builds the (q=1, t) mesh lazily; 0 keeps
+    the unsharded path; an over-sized t degrades with a warning, never
+    fails."""
+    from opendht_tpu.runtime.config import Config
+    from opendht_tpu.runtime.dht import Dht
+
+    d0 = Dht(lambda data, addr: 0, Config())
+    assert d0.resolve_mesh() is None and d0.resolve_mesh_t() == 1
+    d4 = Dht(lambda data, addr: 0, Config(resolve_mesh_t=4))
+    m = d4.resolve_mesh()
+    assert m is not None and m.shape["t"] == 4 and m.shape["q"] == 1
+    assert d4.resolve_mesh_t() == 4
+    assert d4.wave_builder.snapshot()["table_shard_t"] == 4
+    d_big = Dht(lambda data, addr: 0, Config(resolve_mesh_t=512))
+    assert d_big.resolve_mesh() is None and d_big.resolve_mesh_t() == 1
+
+
 def test_sharded_maintenance_sweep_padded_table(mesh):
     """Invalid pad rows (the pad_to_multiple contract) contribute to no
     bucket and no staleness."""
