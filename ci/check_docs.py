@@ -43,6 +43,20 @@ _SUFFIX = {"K": 1e3, "M": 1e6, "B": 1e9}
 _OPTIONAL = ("config3_tp",)
 
 
+def _para_at(lines, idx):
+    """The markdown paragraph (contiguous non-blank lines) containing
+    line ``idx``, joined with spaces — wrapped prose puts a tag's
+    quoted figures on neighboring lines.  The ONE copy of the
+    boundary scan every paragraph-scoped rule uses."""
+    lo = idx
+    while lo > 0 and lines[lo - 1].strip():
+        lo -= 1
+    hi = idx
+    while hi + 1 < len(lines) and lines[hi + 1].strip():
+        hi += 1
+    return " ".join(lines[lo:hi + 1])
+
+
 def _rate_quotes(line):
     """All 'X.XX[KMB] <unit>/s' figures on a doc line."""
     return [(float(v) * _SUFFIX[s], v + s)
@@ -130,16 +144,7 @@ def check_config_captures(failures):
                 if tag not in ln:
                     continue
                 any_tagged = True
-                # the tag's whole markdown paragraph (contiguous
-                # non-blank lines): wrapped prose puts the quoted
-                # figures on lines after the tag
-                lo = li
-                while lo > 0 and lines[lo - 1].strip():
-                    lo -= 1
-                hi = li
-                while hi + 1 < len(lines) and lines[hi + 1].strip():
-                    hi += 1
-                para = " ".join(lines[lo:hi + 1])
+                para = _para_at(lines, li)
                 # only the line's FIRST rate figure is the artifact's
                 # primary value; later figures on the same line quote
                 # secondary fields (e.g. the latency sweep's per-wave
@@ -275,13 +280,7 @@ def check_tp_wire(failures):
                             f"budget (TP_SCALING.json)")
             continue
         for li in tagged:
-            lo = li
-            while lo > 0 and lines[lo - 1].strip():
-                lo -= 1
-            hi = li
-            while hi + 1 < len(lines) and lines[hi + 1].strip():
-                hi += 1
-            para = " ".join(lines[lo:hi + 1])
+            para = _para_at(lines, li)
             quoted_b = [float(v) for v in re.findall(
                 r"(\d+(?:\.\d+)?) ?B(?:ytes)? per query per hop", para)]
             quoted_s = [int(v) for v in re.findall(
@@ -303,6 +302,55 @@ def check_tp_wire(failures):
                     failures.append(
                         f"{name}: [tp:wire] quotes {qs} in-loop "
                         f"collective(s) vs TP_SCALING.json {want_sites}")
+
+
+def check_health_overhead(failures):
+    """Round-14 rule, BOTH directions: the health-evaluator overhead
+    acceptance (<1% on the 8192-wave round) is quote-enforced against
+    ``captures/health_overhead.json`` — (1) the artifact itself must
+    satisfy the acceptance bound it records (``value`` <
+    ``acceptance_pct``: a regression that pushes the evaluator past
+    its budget fails CI here even before the docs drift), and (2)
+    README *and* PARITY must each carry a
+    ``<!-- capture:health_overhead -->``-tagged paragraph stating the
+    ``<{acceptance}%`` bound next to the measured quote (the generic
+    percent rule in check_config_captures checks the measured value;
+    this rule checks the *claim* survives in both docs)."""
+    cap_path = os.path.join(ROOT, "captures", "health_overhead.json")
+    if not os.path.exists(cap_path):
+        return
+    with open(cap_path) as f:
+        cap = json.load(f)
+    acc = float(cap.get("acceptance_pct", 1.0))
+    if cap["value"] >= acc:
+        failures.append(
+            "captures/health_overhead.json: measured overhead "
+            f"{cap['value']}% breaks its own <{acc:g}% acceptance "
+            f"bound — the health tick got expensive")
+    tag = "<!-- capture:health_overhead -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the health-evaluator overhead")
+            continue
+        for li in tagged:
+            para = _para_at(lines, li)
+            quoted = re.findall(r"<(\d+(?:\.\d+)?)% acceptance", para)
+            if not quoted:
+                failures.append(
+                    f"{name}: [capture:health_overhead] paragraph "
+                    f"states no '<N% acceptance' bound")
+            for q in quoted:
+                if float(q) != acc:
+                    failures.append(
+                        f"{name}: [capture:health_overhead] states a "
+                        f"<{q}% acceptance vs the artifact's "
+                        f"acceptance_pct={acc:g}")
 
 
 def check_trajectory(failures):
@@ -338,15 +386,8 @@ def check_trajectory(failures):
         return
     quoted = []
     for li in tagged:
-        lo = li
-        while lo > 0 and lines[lo - 1].strip():
-            lo -= 1
-        hi = li
-        while hi + 1 < len(lines) and lines[hi + 1].strip():
-            hi += 1
-        para = " ".join(lines[lo:hi + 1])
         quoted += [float(v) for v in
-                   re.findall(r"(\d+(?:\.\d+)?)[x×]", para)]
+                   re.findall(r"(\d+(?:\.\d+)?)[x×]", _para_at(lines, li))]
     for r in committed.get("rounds", []):
         v = r.get("vs_baseline")
         if not v:
@@ -363,6 +404,7 @@ def main() -> int:
     cap = check_headline(failures)
     checked = check_config_captures(failures)
     check_tp_wire(failures)
+    check_health_overhead(failures)
     check_trajectory(failures)
     if failures:
         print("DOCS DRIFT from capture artifacts:")

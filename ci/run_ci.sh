@@ -207,6 +207,37 @@ from opendht_tpu.testing.ingest_smoke import main
 rc = main()
 assert rc == 0, "ingest smoke failed"
 PY
+# health observatory smoke (round 14): boot a 3-node real-UDP cluster +
+# proxy, assert GET /healthz flips 503->200 through bootstrap, run the
+# batched replica-coverage probe (the whole sampled key set's true
+# closest-8 in ONE launch) against the live stores, then choke ingest
+# admission and assert the availability SLO fast-burns the verdict to
+# unhealthy with health_transition/slo_violation events in the flight
+# recorder and dhtmon exiting non-zero on the lookup-success invariant.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.health_smoke import main
+rc = main()
+assert rc == 0, "health smoke failed"
+PY
+# health-evaluator overhead smoke (round 14): with the evaluator
+# ticking once per wave, the search round must stay inside a generous
+# 5% band vs the evaluator-free run (the committed
+# captures/health_overhead.json documents the tight number against the
+# <1% acceptance, enforced against the README quote by check_docs).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_health_r14", pathlib.Path("benchmarks/exp_health_r14.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "health overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

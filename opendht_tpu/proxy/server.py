@@ -358,6 +358,28 @@ def _make_handler(server: DhtProxyServer):
             if not parts:                      # GET / → node info (:206-232)
                 self._send_json(server._node_info())
                 return
+            if parts == ["healthz"]:
+                # GET /healthz → readiness probe (ISSUE-9): 200 when the
+                # node's health verdict is healthy/degraded (serving,
+                # possibly impaired), 503 when unhealthy or unknown
+                # (disconnected, pre-first-tick, or health disabled) —
+                # k8s/LB readiness semantics, with the full verdict +
+                # per-signal/SLO attribution as the JSON body.  Like
+                # /stats, "healthz" is not a valid hash so the path was
+                # previously a 400 and stays unambiguous.
+                rep = {}
+                try:
+                    rep = runner.get_health()
+                except Exception:
+                    pass
+                verdict = rep.get("verdict", "unknown")
+                ready = verdict in ("healthy", "degraded")
+                body = {"ready": ready, "verdict": verdict,
+                        "node_id": runner.get_node_id().hex(),
+                        "status": runner.get_status().name,
+                        "health": rep}
+                self._send_json(body, 200 if ready else 503)
+                return
             if parts == ["stats"]:
                 # GET /stats → Prometheus text exposition of the unified
                 # telemetry registry (ISSUE-3; extends the reference's

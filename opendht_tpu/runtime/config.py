@@ -7,6 +7,11 @@ import enum
 from dataclasses import dataclass, field
 from typing import Optional
 
+# the declarative SLO/health config (round 14) lives in
+# opendht_tpu/health.py (import-light, stdlib + telemetry spine) and is
+# re-exported here because runtime/config.py is where node behavior is
+# configured — `Config.health` is the knob surface
+from ..health import HealthConfig, SloObjective, default_slos  # noqa: F401
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -86,6 +91,17 @@ class Config:
     #: unsharded with a logged warning when the host has fewer).
     #: Results are bit-identical either way (tests/test_sharded.py).
     resolve_mesh_t: int = 0
+
+    # --- health observatory (round 14, opendht_tpu/health.py) ---------
+    #: declarative SLO engine + per-node health verdict: per-op
+    #: availability/latency objectives with multi-window burn-rate
+    #: evaluation, derived signals (ingest queue saturation, scheduler
+    #: tick lag, request timeout ratio, stale buckets, connectivity),
+    #: evaluated every ``health.period`` seconds on the node scheduler
+    #: and exported as `dht_health_*`/`dht_slo_*` gauges, flight
+    #: events, and the proxy's readiness route ``GET /healthz``.
+    #: ``health.period = 0`` disables the tick entirely.
+    health: HealthConfig = field(default_factory=HealthConfig)
 
 
 @dataclass

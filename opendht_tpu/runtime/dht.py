@@ -1779,6 +1779,25 @@ class Dht:
         with reg.span("dht_maintenance_sweep_seconds"):
             stale, targets = table.maintenance_sweep(now)
         self._m_maint_sweeps.inc()
+        # publish the stale-bucket fraction + occupancy per family
+        # (round 14): the health evaluator's ``stale_buckets`` signal
+        # reads these gauges instead of launching its own sweep — the
+        # fused pass already computed occupancy AND staleness, so
+        # health costs no kernel.  Occupancy rides along because the
+        # fraction is only statistically meaningful on tables with
+        # enough occupied buckets (a 3-node table's 1-2 buckets swing
+        # the fraction 0→1 on one never-replied peer).
+        # keyed by node AND family: co-resident nodes in one process
+        # share the registry (documented round-8 semantics), and a
+        # node-less key would let node A's sweep overwrite the signal
+        # node B's health evaluator reads (review finding)
+        fam = "ipv4" if af == _socket.AF_INET else "ipv6"
+        nid = str(self.myid)
+        occupied = int(np.count_nonzero(table.bucket_occupancy()))
+        reg.gauge("dht_maintenance_stale_fraction", family=fam,
+                  node=nid).set(len(stale) / occupied if occupied else 0.0)
+        reg.gauge("dht_maintenance_occupied_buckets", family=fam,
+                  node=nid).set(occupied)
         if len(stale) == 0:
             return False
         raw = IK.ids_to_bytes(targets)

@@ -32,7 +32,7 @@ import threading
 import time as _time
 from typing import Callable, List, Optional, Tuple
 
-from .. import telemetry, tracing
+from .. import health as _health, telemetry, tracing
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
 from ..utils import TIME_MAX, lazy_module
@@ -138,6 +138,7 @@ class DhtRunner:
 
     def __init__(self):
         self._dht: Optional[SecureDht] = None
+        self._health: "_health.NodeHealth | None" = None
         self._sock4: Optional[_socket.socket] = None
         self._sock6: Optional[_socket.socket] = None
         self._udp = None                       # native UdpEngine (IPv4)
@@ -197,6 +198,16 @@ class DhtRunner:
         self._dht = SecureDht(dht, config.identity)
         dht.status_cb = lambda s4, s6: None   # runner tracks status itself
         dht.warmup()     # compile hot kernels before serving any packet
+
+        # health observatory (round 14): the declarative SLO engine +
+        # node verdict, evaluated on a periodic scheduler tick riding
+        # the same DHT thread as every other job (host-side snapshot
+        # subtraction only — no device work, kernels untouched)
+        self._health = None
+        if dht_config.health.period > 0:
+            self._health = _health.NodeHealth(
+                dht, dht_config.health, node=str(dht.get_node_id()))
+            self._health.attach(dht.scheduler)
 
         self.running = True
         if config.threaded:
@@ -803,6 +814,20 @@ class DhtRunner:
             pass
         return reg.snapshot()
 
+    def get_health(self) -> dict:
+        """The node's current health report (ISSUE-9): the verdict
+        (``healthy | degraded | unhealthy``; ``unknown`` before the
+        first tick or with ``health.period = 0``) plus per-signal and
+        per-SLO attribution — the JSON the proxy's ``GET /healthz``
+        route serves and the ``health`` REPL command prints."""
+        h = self._health
+        if h is None:
+            return {"verdict": "unknown", "enabled": False,
+                    "signals": {}, "slo": {}, "unknown": []}
+        rep = dict(h.report())
+        rep["enabled"] = True
+        return rep
+
     def get_trace(self, trace_id) -> list:
         """JSON-able span list of one distributed trace (ISSUE-4): the
         op root span plus every per-hop client span this node sent and
@@ -812,12 +837,19 @@ class DhtRunner:
         every cluster node and stitches the full tree."""
         return tracing.get_tracer().spans(trace_id)
 
-    def get_flight_recorder(self, limit: "int | None" = None) -> dict:
+    def get_flight_recorder(self, limit: "int | None" = None,
+                            name: "str | None" = None) -> dict:
         """The bounded-ring flight recorder dump (↔ the reference's
         ``Dht::dumpTables`` postmortem surface, structured): last-N
         spans + events (request transitions, timeouts, rate-limit
-        drops, compactions, churn swaps)."""
-        d = tracing.get_tracer().dump()
+        drops, compactions, churn swaps, health transitions).
+
+        ``name`` filters by event/span name substring at DUMP time
+        (e.g. ``"health"`` keeps ``health_transition`` events and
+        nothing else) — the ring itself is untouched, so eviction
+        order is identical with or without a filter (ISSUE-9
+        satellite; pinned in tests/test_health.py)."""
+        d = tracing.get_tracer().dump(name=name)
         if limit:
             d["spans"] = d["spans"][-limit:]
             d["events"] = d["events"][-limit:]

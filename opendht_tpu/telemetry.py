@@ -43,7 +43,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Span",
-    "get_registry", "snapshot_diff",
+    "get_registry", "quantile_from_buckets", "snapshot_diff",
 ]
 
 # histogram buckets are powers of two: bucket i covers
@@ -67,6 +67,26 @@ def _bucket_index(v: float) -> int:
 
 def _bucket_le(i: int) -> float:
     return math.ldexp(1.0, i - _H_OFFSET)
+
+
+def quantile_from_buckets(items, total: int, q: float) -> float:
+    """Linear-interpolated quantile over sorted ``(bucket_index,
+    count)`` pairs of the log-bucket scheme — the ONE copy of the
+    interpolation used by :meth:`Histogram.quantile` and the windowed
+    bucket-delta readers in opendht_tpu/health.py (keeping the two
+    from diverging).  0.0 when ``total`` is zero."""
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in items:
+        if cum + c >= target:
+            lo = 0.0 if i == 0 else _bucket_le(i - 1)
+            hi = _bucket_le(i)
+            frac = (target - cum) / c if c else 1.0
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return _bucket_le(items[-1][0])
 
 
 class Counter:
@@ -161,18 +181,15 @@ class Histogram:
         with self._lock:
             total = self.count
             items = sorted(self.buckets.items())
-        if total == 0:
-            return 0.0
-        target = q * total
-        cum = 0
-        for i, c in items:
-            if cum + c >= target:
-                lo = 0.0 if i == 0 else _bucket_le(i - 1)
-                hi = _bucket_le(i)
-                frac = (target - cum) / c if c else 1.0
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            cum += c
-        return _bucket_le(items[-1][0])
+        return quantile_from_buckets(items, total, q)
+
+    def raw(self) -> tuple:
+        """Consistent ``(count, sum, {bucket_index: count})`` snapshot —
+        the windowed readers (opendht_tpu/health.py) diff two of these
+        to get a bucket-exact view of one time window without any new
+        instrumentation on the observing side."""
+        with self._lock:
+            return self.count, self.sum, dict(self.buckets)
 
     def to_dict(self) -> dict:
         with self._lock:
@@ -318,6 +335,17 @@ class MetricsRegistry:
         hist = (self.histogram(name, **labels)
                 if self.enabled and record else None)
         return _SpanCtx(hist, name)
+
+    def series(self, name: str) -> dict:
+        """All label series of one metric family as ``{label_key:
+        metric}`` (empty when the family was never written).  Lets a
+        reader aggregate over labels — e.g. the health evaluator's
+        timeout ratio sums every ``type=`` series — without the full
+        :meth:`snapshot` (which computes quantiles for every
+        histogram in the process)."""
+        with self._lock:
+            ent = self._metrics.get(name)
+            return dict(ent[1]) if ent is not None else {}
 
     # --------------------------------------------------------------- export
     def snapshot(self) -> dict:

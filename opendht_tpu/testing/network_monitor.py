@@ -36,6 +36,11 @@ import time
 from datetime import datetime
 
 from .. import telemetry
+# the --alert PCT=SEC grammar and the cumulative-percentile check are
+# shared with testing/health_monitor.py and tools/dhtmon.py (ISSUE-9
+# satellite) — one copy, in opendht_tpu/health.py; parse_alerts stays
+# importable from this module for existing callers
+from ..health import parse_alerts, percentile_breaches  # noqa: F401
 from ..infohash import InfoHash
 from ..core.value import Value
 from ..runtime.config import NodeStatus
@@ -122,21 +127,6 @@ class Monitor:
         self.node2.join()
 
 
-def parse_alerts(specs) -> dict:
-    """``["p95=2.5", "50=1"]`` → {95: 2.5, 50: 1.0}; raises ValueError
-    on malformed specs or percentiles outside (0, 100)."""
-    out: dict = {}
-    for spec in specs or ():
-        name, _, thr = spec.partition("=")
-        if not thr:
-            raise ValueError("alert spec %r is not PCT=SECONDS" % spec)
-        p = float(name.lstrip("pP"))
-        if not 0 < p < 100:
-            raise ValueError("alert percentile %r outside (0, 100)" % name)
-        out[p] = float(thr)
-    return out
-
-
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="monitor a DHT network with periodic put->listen probes")
@@ -186,11 +176,13 @@ def main(argv=None) -> int:
                   "Test completed successfully in", round(dt, 3),
                   "| round-trip " + " ".join(
                       "p%g=%.3fs" % (p, v) for p, v in sorted(pcts.items())))
-            for pct, thr in sorted(alerts.items()):
-                if pcts[pct] > thr:
+            breaches = percentile_breaches(
+                lambda q: mon.rtt.quantile(q), alerts)
+            if breaches:
+                for pct, v, thr in breaches:
                     print("ALERT: round-trip p%g %.3fs exceeds %.3fs"
-                          % (pct, pcts[pct], thr), file=sys.stderr)
-                    return 1
+                          % (pct, v, thr), file=sys.stderr)
+                return 1
             done_rounds += 1
             if args.rounds and done_rounds >= args.rounds:
                 break

@@ -1,0 +1,184 @@
+"""dhtmon: cluster health invariants CLI (ISSUE-9).
+
+Scrapes every listed node's ``GET /healthz`` + ``GET /stats`` (the
+proxy surfaces of opendht_tpu/health.py) and checks the cluster
+invariants with exit-code thresholds, so one command drives CI gates,
+soak monitors and pager policy:
+
+- per-node verdicts (``--require-ready`` fails unless every node's
+  /healthz returns 200);
+- global lookup success rate from the summed op-outcome counters
+  (``--min-success R``);
+- cluster op-latency percentiles from the merged
+  ``dht_op_seconds_bucket`` series, via the ONE ``--alert PCT=SEC``
+  grammar shared with testing/network_monitor.py (health.parse_alerts);
+- the batched replica-coverage probe (``--min-coverage R``) when
+  invoked programmatically with in-process runners
+  (:func:`run_checks` ``runners=``; the probe needs the cluster's
+  stores — testing/health_monitor.replica_coverage), resolving every
+  sampled key's true closest-8 in ONE batched device launch.
+
+Exit codes: 0 = all invariants hold; 1 = an invariant violated;
+2 = usage / scrape error.
+
+Usage::
+
+    python -m opendht_tpu.tools.dhtmon --nodes 127.0.0.1:8080 \\
+        --min-success 0.99 --alert p95=2.5 --require-ready [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from ..health import parse_alerts, percentile_breaches
+from ..testing import health_monitor as hm
+
+
+def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
+               min_success: Optional[float] = None,
+               min_coverage: Optional[float] = None,
+               require_ready: bool = False, op: str = "get",
+               sample_max: int = 64, k: int = 8, mesh=None,
+               window: float = 0.0) -> tuple:
+    """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
+    is the JSON-able cluster report and ``violations`` is a list of
+    human-readable invariant failures (empty = healthy).
+
+    ``window > 0`` evaluates the success/latency invariants over a
+    WINDOW: scrape, wait ``window`` seconds, scrape again, and diff
+    the cumulative series (the node evaluator's snapshot-subtraction
+    move, cluster-side).  The default (0) reads the since-boot
+    cumulative ratio — right for a CI smoke's bounded lifetime, wrong
+    for a week-old soak, where lifetime counters both hide a fresh
+    outage and remember a recovered one forever (review finding)."""
+    alerts = alerts or {}
+    violations: List[str] = []
+    baseline = None
+    if window > 0 and endpoints:
+        baseline = hm.merge_series([hm.scrape_node(ep)
+                                    for ep in endpoints])
+        time.sleep(window)
+    scrapes = []
+    for ep in endpoints:
+        scrapes.append(hm.scrape_node(ep))
+    doc: dict = {
+        "nodes": [{"endpoint": s["endpoint"], "ready": s["ready"],
+                   "verdict": s["verdict"]} for s in scrapes],
+        "window_s": window or None,
+    }
+    if require_ready:
+        for s in scrapes:
+            if not s["ready"]:
+                violations.append("node %s not ready (verdict %s)"
+                                  % (s["endpoint"], s["verdict"]))
+    series = hm.merge_series(scrapes) if scrapes else {}
+    if baseline is not None:
+        # cumulative counters and cumulative-by-le buckets both diff
+        # cleanly; only the summed counter/bucket series are read below
+        series = {key: max(v - baseline.get(key, 0.0), 0.0)
+                  for key, v in series.items()}
+    ls = hm.lookup_success(series, op=op) if series else None
+    doc["lookup_success"] = (
+        {"ratio": ls[0], "ops": ls[1]} if ls is not None else None)
+    if min_success is not None and ls is not None and ls[0] < min_success:
+        violations.append(
+            "lookup success %.4f < %.4f over %d %s ops"
+            % (ls[0], min_success, int(ls[1]), op))
+    if alerts and series:
+        doc["latency"] = {
+            "p%g" % p: hm.cluster_quantile(series, op, p / 100.0)
+            for p in sorted(alerts)}
+        for pct, v, thr in percentile_breaches(
+                lambda q: hm.cluster_quantile(series, op, q), alerts):
+            violations.append("cluster %s p%g %.3fs exceeds %.3fs"
+                              % (op, pct, v, thr))
+    if runners:
+        cov = hm.replica_coverage(runners, sample_max=sample_max, k=k,
+                                  mesh=mesh)
+        doc["replica_coverage"] = cov
+        if min_coverage is not None and cov["keys"] and \
+                cov["mean_coverage"] < min_coverage:
+            violations.append(
+                "replica coverage %.3f < %.3f over %d keys "
+                "(min per-key %.3f)"
+                % (cov["mean_coverage"], min_coverage, cov["keys"],
+                   cov["min_coverage"]))
+    doc["violations"] = violations
+    return violations, doc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="cluster health invariants monitor (exit-code "
+                    "thresholds for CI and soak)")
+    p.add_argument("--nodes", action="append", default=[],
+                   metavar="HOST:PORT[,HOST:PORT...]",
+                   help="proxy endpoints to scrape (repeatable or "
+                        "comma-separated)")
+    p.add_argument("--alert", action="append", default=[],
+                   metavar="PCT=SEC",
+                   help="fail when the cluster-merged op latency "
+                        "percentile exceeds SEC (e.g. --alert p95=2.5; "
+                        "the same grammar as network_monitor)")
+    p.add_argument("--min-success", type=float, default=None,
+                   metavar="R",
+                   help="fail when the global lookup success ratio "
+                        "drops below R (e.g. 0.99)")
+    p.add_argument("--require-ready", action="store_true",
+                   help="fail unless every node's GET /healthz is 200")
+    p.add_argument("--op", default="get",
+                   help="op family for the success/latency invariants "
+                        "(default: get)")
+    p.add_argument("--window", type=float, default=0.0, metavar="SEC",
+                   help="evaluate success/latency over a SEC-second "
+                        "window (scrape, wait, scrape, diff) instead "
+                        "of the since-boot cumulative — use for "
+                        "long-lived clusters, where lifetime ratios "
+                        "hide fresh outages and remember recovered "
+                        "ones")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full cluster report as one JSON doc")
+    args = p.parse_args(argv)
+    try:
+        alerts = parse_alerts(args.alert)
+    except ValueError as e:
+        print("dhtmon:", e, file=sys.stderr)
+        return 2
+    endpoints = [ep for spec in args.nodes for ep in spec.split(",") if ep]
+    if not endpoints:
+        print("dhtmon: no --nodes given", file=sys.stderr)
+        return 2
+    try:
+        violations, doc = run_checks(
+            endpoints, alerts=alerts, min_success=args.min_success,
+            require_ready=args.require_ready, op=args.op,
+            window=args.window)
+    except Exception as e:
+        print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(doc, sys.stdout)
+        print()
+    else:
+        for n in doc["nodes"]:
+            print("node %s: %s%s" % (n["endpoint"], n["verdict"],
+                                     "" if n["ready"] else " (NOT READY)"))
+        ls = doc.get("lookup_success")
+        if ls:
+            print("lookup success: %.4f over %d ops"
+                  % (ls["ratio"], int(ls["ops"])))
+        for name, v in sorted((doc.get("latency") or {}).items()):
+            print("cluster %s %s: %s" % (
+                args.op, name, "%.3fs" % v if v is not None else "n/a"))
+    for v in violations:
+        print("ALERT:", v, file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

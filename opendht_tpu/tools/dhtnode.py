@@ -37,9 +37,15 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            ids in the ring; '<trace id>' = that trace's
                            span tree; 'chrome [file]' = Perfetto/Chrome
                            trace-event dump (stdout or file)
-    dump [n]               flight-recorder dump: last n (default 40)
+    health                 node health verdict (healthy | degraded |
+                           unhealthy) with per-signal and per-SLO
+                           burn-rate attribution — the same JSON the
+                           proxy serves on GET /healthz
+    dump [n] [name]        flight-recorder dump: last n (default 40)
                            structured events + span count (the
-                           reference's dumpTables analogue)
+                           reference's dumpTables analogue); a
+                           non-numeric arg filters by event/span name
+                           substring (e.g. 'dump health')
     stt <port>             start REST proxy server
     stp                    stop REST proxy server
     pst <host:port>        switch backend to a REST proxy (client)
@@ -207,15 +213,31 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                     for tid_, (cnt, name) in list(seen.items())[-20:]:
                         print("  %s  %3d spans  (%s)" % (tid_, cnt, name))
                     print("%d trace(s) in the ring" % len(seen))
+            elif op == "health":
+                # the node health verdict (ISSUE-9): same report the
+                # proxy serves on GET /healthz
+                import json as _json
+                rep = node.get_health()
+                print(_json.dumps(rep, indent=2, sort_keys=True))
+                print("verdict: %s%s" % (
+                    rep.get("verdict", "unknown"),
+                    " (causes: %s)" % ", ".join(rep["causes"])
+                    if rep.get("causes") else ""))
             elif op == "dump":
                 import json as _json
-                n = int(rest[0]) if rest else 40
-                d = node.get_flight_recorder(limit=n)
+                n, name = 40, None
+                for arg in rest[:2]:
+                    if arg.isdigit():
+                        n = int(arg)
+                    else:
+                        name = arg       # e.g. 'dump health'
+                d = node.get_flight_recorder(limit=n, name=name)
                 print(_json.dumps(d["events"], indent=2, sort_keys=True))
-                print("flight recorder: %d/%d events shown, %d spans, "
-                      "ring capacity %d" % (len(d["events"]), n,
-                                            len(d["spans"]),
-                                            d["capacity"]))
+                print("flight recorder: %d/%d events shown%s, %d spans, "
+                      "ring capacity %d" % (
+                          len(d["events"]), n,
+                          " (filter %r)" % name if name else "",
+                          len(d["spans"]), d["capacity"]))
             elif op == "ll":
                 d = node._dht
                 for af in (socket.AF_INET,):

@@ -62,8 +62,16 @@ def topology_snapshot(node) -> dict:
         "maintenance": {},
         "ingest": {},
         "kernels": {},
+        "health": {},
         "events": [],
     }
+    try:
+        # round-14 health observatory: the node verdict + per-signal /
+        # per-SLO attribution, so a soak diff shows WHEN a node
+        # degraded and what drove it, not just that counters moved
+        snap["health"] = node.get_health()
+    except Exception:
+        pass
     try:
         # round-12 ingest surface: the wave builder's queue depth /
         # occupancy p50-p95 / time-in-queue / shed state, so the soak

@@ -403,15 +403,23 @@ class Tracer:
             out = [r for r in out if r["trace_id"] == want]
         return out
 
-    def events(self, limit: Optional[int] = None) -> List[dict]:
-        out = [r for r in self.records() if "ev" in r]
+    def events(self, limit: Optional[int] = None,
+               name: Optional[str] = None) -> List[dict]:
+        """Flight events, optionally name-filtered (substring match,
+        e.g. ``"health"`` keeps ``health_transition``)."""
+        out = [r for r in self.records()
+               if "ev" in r and (name is None or name in r["ev"])]
         return out[-limit:] if limit else out
 
-    def dump(self) -> dict:
+    def dump(self, name: Optional[str] = None) -> dict:
         """The full flight-recorder dump (↔ ``Dht::dumpTables`` as a
         structured artifact): node tag, capacity, every retained span
-        and event."""
+        and event.  ``name`` filters spans AND events by name
+        substring at dump time — a read-side projection only: the ring
+        and its eviction order are untouched (ISSUE-9 satellite)."""
         recs = self.records()
+        if name is not None:
+            recs = [r for r in recs if name in r.get("ev", r.get("name", ""))]
         return {
             "node": self.node,
             "capacity": self.capacity,
