@@ -304,53 +304,137 @@ def check_tp_wire(failures):
                         f"collective(s) vs TP_SCALING.json {want_sites}")
 
 
-def check_health_overhead(failures):
-    """Round-14 rule, BOTH directions: the health-evaluator overhead
+#: overhead-acceptance artifacts (the round-14 health rule, extended
+#: round 15 to the keyspace observatory): each capture must beat its
+#: own recorded acceptance bound, and both docs must state the bound
+_OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead")
+
+
+def check_overhead_captures(failures):
+    """Rounds 14/15 rule, BOTH directions and for EVERY overhead
+    artifact in :data:`_OVERHEAD_CAPS`: the measured on-cost
     acceptance (<1% on the 8192-wave round) is quote-enforced against
-    ``captures/health_overhead.json`` — (1) the artifact itself must
-    satisfy the acceptance bound it records (``value`` <
-    ``acceptance_pct``: a regression that pushes the evaluator past
-    its budget fails CI here even before the docs drift), and (2)
-    README *and* PARITY must each carry a
-    ``<!-- capture:health_overhead -->``-tagged paragraph stating the
-    ``<{acceptance}%`` bound next to the measured quote (the generic
-    percent rule in check_config_captures checks the measured value;
-    this rule checks the *claim* survives in both docs)."""
-    cap_path = os.path.join(ROOT, "captures", "health_overhead.json")
-    if not os.path.exists(cap_path):
-        return
-    with open(cap_path) as f:
-        cap = json.load(f)
-    acc = float(cap.get("acceptance_pct", 1.0))
-    if cap["value"] >= acc:
-        failures.append(
-            "captures/health_overhead.json: measured overhead "
-            f"{cap['value']}% breaks its own <{acc:g}% acceptance "
-            f"bound — the health tick got expensive")
-    tag = "<!-- capture:health_overhead -->"
+    ``captures/<name>.json`` — (1) the artifact itself must satisfy
+    the acceptance bound it records (``value`` < ``acceptance_pct``: a
+    regression that pushes the instrumented path past its budget fails
+    CI here even before the docs drift), and (2) README *and* PARITY
+    must each carry a ``<!-- capture:<name> -->``-tagged paragraph
+    stating the ``<{acceptance}%`` bound next to the measured quote
+    (the generic percent rule in check_config_captures checks the
+    measured value; this rule checks the *claim* survives in both
+    docs)."""
+    for cname in _OVERHEAD_CAPS:
+        cap_path = os.path.join(ROOT, "captures", cname + ".json")
+        if not os.path.exists(cap_path):
+            continue
+        with open(cap_path) as f:
+            cap = json.load(f)
+        acc = float(cap.get("acceptance_pct", 1.0))
+        if cap["value"] >= acc:
+            failures.append(
+                f"captures/{cname}.json: measured overhead "
+                f"{cap['value']}% breaks its own <{acc:g}% acceptance "
+                f"bound — the instrumented path got expensive")
+        tag = f"<!-- capture:{cname} -->"
+        for name in ("README.md", "PARITY.md"):
+            path = os.path.join(ROOT, name)
+            if not os.path.exists(path):
+                continue
+            lines = open(path).read().splitlines()
+            tagged = [i for i, ln in enumerate(lines) if tag in ln]
+            if not tagged:
+                failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                                f"quoting the {cname} measurement")
+                continue
+            for li in tagged:
+                para = _para_at(lines, li)
+                quoted = re.findall(r"<(\d+(?:\.\d+)?)% acceptance", para)
+                if not quoted:
+                    failures.append(
+                        f"{name}: [capture:{cname}] paragraph "
+                        f"states no '<N% acceptance' bound")
+                for q in quoted:
+                    if float(q) != acc:
+                        failures.append(
+                            f"{name}: [capture:{cname}] states a "
+                            f"<{q}% acceptance vs the artifact's "
+                            f"acceptance_pct={acc:g}")
+
+
+#: the observability index (ISSUE-10 satellite): every serving surface
+#: and the reference counterpart(s) it maps to.  BOTH directions: each
+#: surface must appear as a row of the tagged table in README AND
+#: PARITY, and every row of that table must name a surface registered
+#: here — adding a surface without registering it fails CI.
+OBS_SURFACES = ("GET /stats", "GET /trace", "GET /healthz",
+                "GET /keyspace", "kernel ledger", "dhtscanner --json")
+OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /")
+
+
+def check_observability_index(failures):
+    """The ``<!-- obs:index -->``-tagged table in README and PARITY
+    must list every surface in :data:`OBS_SURFACES` with at least one
+    reference counterpart from :data:`OBS_REFERENCES` on its row, and
+    must contain no row naming an unregistered surface (so a new
+    surface forces this rule — and hence the mapping — to be
+    updated)."""
     for name in ("README.md", "PARITY.md"):
         path = os.path.join(ROOT, name)
         if not os.path.exists(path):
             continue
         lines = open(path).read().splitlines()
-        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        tagged = [i for i, ln in enumerate(lines)
+                  if "<!-- obs:index -->" in ln]
         if not tagged:
-            failures.append(f"{name}: no '{tag}'-tagged paragraph "
-                            f"quoting the health-evaluator overhead")
+            failures.append(f"{name}: no '<!-- obs:index -->'-tagged "
+                            f"observability-index table mapping the "
+                            f"serving surfaces to the reference")
             continue
-        for li in tagged:
-            para = _para_at(lines, li)
-            quoted = re.findall(r"<(\d+(?:\.\d+)?)% acceptance", para)
-            if not quoted:
-                failures.append(
-                    f"{name}: [capture:health_overhead] paragraph "
-                    f"states no '<N% acceptance' bound")
-            for q in quoted:
-                if float(q) != acc:
+        # every tagged table is validated (a stale second copy must
+        # not escape the unregistered-row direction); the
+        # missing-surface direction checks the union across tables
+        seen = []
+        for ti in tagged:
+            # the table: contiguous '|' rows following the tag line
+            rows = []
+            li = ti + 1
+            while li < len(lines) and lines[li].lstrip().startswith("|"):
+                cells = [c.strip() for c in lines[li].strip().strip("|")
+                         .split("|")]
+                if cells and not set(cells[0]) <= set("-: "):
+                    rows.append((cells[0], lines[li]))
+                li += 1
+            body = [r for r in rows[1:]]          # drop the header row
+            if not body:
+                failures.append(f"{name}: [obs:index] tag has no table "
+                                f"rows under it")
+                continue
+            for surface, raw in body:
+                # exact match after stripping markdown formatting — a
+                # substring test would let 'GET /keyspace/top' ride the
+                # 'GET /keyspace' registration unflagged, defeating the
+                # adding-a-surface-forces-this-rule direction (review
+                # finding)
+                canon = surface.replace("`", "").replace("*", "").strip()
+                matched = next((s for s in OBS_SURFACES
+                                if canon.lower() == s.lower()), None)
+                if matched is None:
                     failures.append(
-                        f"{name}: [capture:health_overhead] states a "
-                        f"<{q}% acceptance vs the artifact's "
-                        f"acceptance_pct={acc:g}")
+                        f"{name}: [obs:index] row names unregistered "
+                        f"surface {surface!r} — register it in "
+                        f"ci/check_docs.py OBS_SURFACES")
+                    continue
+                seen.append(matched)
+                if not any(ref in raw for ref in OBS_REFERENCES):
+                    failures.append(
+                        f"{name}: [obs:index] row for {matched!r} names "
+                        f"no reference counterpart "
+                        f"({', '.join(OBS_REFERENCES)})")
+        for s in OBS_SURFACES:
+            if s not in seen:
+                failures.append(
+                    f"{name}: [obs:index] table is missing the "
+                    f"{s!r} surface")
 
 
 def check_trajectory(failures):
@@ -404,7 +488,8 @@ def main() -> int:
     cap = check_headline(failures)
     checked = check_config_captures(failures)
     check_tp_wire(failures)
-    check_health_overhead(failures)
+    check_overhead_captures(failures)
+    check_observability_index(failures)
     check_trajectory(failures)
     if failures:
         print("DOCS DRIFT from capture artifacts:")

@@ -238,6 +238,38 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "health overhead smoke failed"
 PY
+# keyspace observatory smoke (round 15): boot a 3-node real-UDP cluster
+# + proxy, drive Zipf-skewed gets/puts through the wave builder, assert
+# the hot key surfaces in GET /keyspace as hot (with a hot_key_emerged
+# flight event), the dht_shard_imbalance gauge exports a known value on
+# GET /stats, and dhtmon --max-imbalance exits 0 on the mixed load then
+# 1 under an injected single-key flood.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.keyspace_smoke import main
+rc = main()
+assert rc == 0, "keyspace smoke failed"
+PY
+# keyspace-observatory overhead smoke (round 15): with the count-min
+# sketch observing every wave's full target batch (one async batched
+# scatter-add per wave + candidate sampling), the search round must
+# stay inside a generous 5% band vs the observatory-free run (the
+# committed captures/keyspace_overhead.json documents the tight number
+# against the <1% acceptance, enforced against the README quote by
+# check_docs above).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_keyspace_r15", pathlib.Path("benchmarks/exp_keyspace_r15.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "keyspace overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

@@ -179,6 +179,15 @@ DEFAULT_SIGNAL_THRESHOLDS = {
     "scheduler_lag": (0.5, 2.0),
     "timeout_ratio": (0.5, 0.9),
     "stale_buckets": (0.6, 0.95),
+    # round 15 (ISSUE-10): max/mean per-shard keyspace traffic off the
+    # observatory's folded histogram — 1.0 is perfect balance, t is a
+    # single-shard flood.  3x the fair share degrades; 6x (a de-facto
+    # single-key/single-shard hot spot at the default 8-way
+    # attribution) would be unhealthy, but the signal is capped at
+    # degraded in the verdict by default (HealthConfig.degrade_only) —
+    # see the field comment.  Unknown below the observatory's
+    # min_observed window, so boot noise never trips it.
+    "shard_imbalance": (3.0, 6.0),
 }
 
 
@@ -207,6 +216,14 @@ class HealthConfig:
     #: signal name -> (degraded, unhealthy) threshold pair
     signal_thresholds: dict = field(
         default_factory=lambda: dict(DEFAULT_SIGNAL_THRESHOLDS))
+    #: signals whose level is capped at degraded in the verdict:
+    #: load-balance attribution is capacity planning, not liveness —
+    #: legitimately concentrated traffic (a republish calendar bin's
+    #: searches all land XOR-close to the node's own id, one narrow
+    #: ring slice) can exceed the unhealthy threshold for a window on
+    #: a perfectly healthy node, and must not 503 its /healthz
+    #: readiness behind a load balancer (review finding)
+    degrade_only: tuple = ("shard_imbalance",)
 
 
 # ====================================================== window bookkeeping
@@ -508,6 +525,8 @@ class HealthEvaluator:
                                if _RANK.get(prev, 0) >= 2 else 1.0)
                 level = (UNHEALTHY if value >= u_thr
                          else DEGRADED if value >= d_thr else HEALTHY)
+                if level == UNHEALTHY and name in cfg.degrade_only:
+                    level = DEGRADED
             self._signal_levels[name] = level
             out[name] = {"level": level, "value": value,
                          "unknown": unknown,
@@ -598,6 +617,7 @@ class NodeHealth:
                 "connectivity": self._connectivity,
                 "ingest_queue": self._ingest_queue,
                 "stale_buckets": self._stale_buckets,
+                "shard_imbalance": self._shard_imbalance,
             })
         self._job = None
 
@@ -639,6 +659,16 @@ class NodeHealth:
                 and occupied.get(key) is not None
                 and occupied[key].value >= self.STALE_MIN_OCCUPIED]
         return max(vals) if vals else None
+
+    def _shard_imbalance(self) -> Optional[float]:
+        """Max/mean per-shard keyspace traffic from the round-15
+        observatory (opendht_tpu/keyspace.py) — already folded over
+        the live t-sharded row boundaries (or the uniform virtual
+        split) on the observatory's own tick, so this is one attribute
+        read.  None (unknown) while the window holds fewer than
+        ``min_observed`` ids — a quiet node is not imbalanced."""
+        ks = getattr(self._dht, "keyspace", None)
+        return ks.imbalance() if ks is not None else None
 
     # --------------------------------------------------------------- tick
     def attach(self, scheduler) -> None:

@@ -190,7 +190,12 @@ TABLE_AXIS_RULES = (
     (r"sorted_ids$|^ids$|^table$|expanded$", P("t", None)),
     (r"local_lut$", P("t", None)),
     (r"block_lut$", P()),
+    # `valid$` also covers the sketch twin's `sketch_valid` mask
     (r"perm$|valid$|n_local$|last_reply$", P("t")),
+    # keyspace sketch traffic (ISSUE-10): the wave's observed ids split
+    # over the table axis — each shard builds a partial sketch, one
+    # psum pair merges (sharded.py sharded_sketch_update)
+    (r"sketch_ids$", P("t", None)),
     (r"targets$|queries$", P("q", None)),
     (r".*", P()),
 )

@@ -573,6 +573,61 @@ def sharded_maintenance_sweep(mesh: Mesh, self_id, ids, valid, last_reply,
 
 
 @functools.lru_cache(maxsize=8)
+def _build_sharded_sketch(mesh: Mesh, depth: int, width: int):
+    from ..ops.sketch import BIN_BITS, hash_columns
+
+    def local(sketch, hist, ids, valid):
+        # each shard scatter-adds its slice of the observed ids into a
+        # ZERO partial sketch/histogram; ONE psum pair merges the
+        # partials onto the replicated running state.  Integer adds
+        # are associative and exact, so the merged result is
+        # bit-identical to the single-device ops.sketch.sketch_update
+        # over the same ids (tests/test_keyspace.py).  Pad rows carry
+        # weight 0 — they touch cells but add nothing.
+        w = valid.astype(jnp.int32)
+        cols = hash_columns(ids, depth, width)            # [Qs, depth]
+        rows = jnp.broadcast_to(jnp.arange(depth, dtype=jnp.int32),
+                                cols.shape)
+        part = jnp.zeros_like(sketch).at[
+            rows.reshape(-1), cols.reshape(-1)].add(
+            jnp.repeat(w, depth))
+        bins = (ids[:, 0] >> _U32(32 - BIN_BITS)).astype(jnp.int32)
+        ph = jnp.zeros_like(hist).at[bins].add(w)
+        return sketch + lax.psum(part, "t"), hist + lax.psum(ph, "t")
+
+    fn = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P("t", None), P("t")),
+        out_specs=(P(), P()),
+        **_SM_KW,
+    )
+    return jax.jit(fn)
+
+
+def sharded_sketch_update(mesh: Mesh, sketch, hist, ids):
+    """tp twin of :func:`opendht_tpu.ops.sketch.sketch_update`
+    (ISSUE-10): the wave's observed ids ROW-SPLIT over the ``t`` axis,
+    each shard building a partial count-min sketch + top-8-bit
+    histogram locally, merged with ONE psum pair — O(depth·width +
+    bins) int32 wire, independent of the wave width.  Ragged widths
+    pad with weight-0 rows (``pad_to_multiple``), so any Q works.
+
+    Returns the updated replicated ``(sketch, hist)``, BIT-IDENTICAL
+    to the single-device update over the same ids (integer adds are
+    exact under resharding; pinned in tests/test_keyspace.py)."""
+    ids = np.asarray(ids, np.uint32).reshape(-1, N_LIMBS)
+    n_t = mesh.shape["t"]
+    padded, n = pad_to_multiple(ids, n_t)
+    valid = np.arange(padded.shape[0]) < n
+    fn = _build_sharded_sketch(mesh, int(sketch.shape[0]),
+                               int(sketch.shape[1]))
+    ops = shard_put(mesh, {"sketch_ids": padded,
+                           "sketch_valid": valid}, TABLE_AXIS_RULES)
+    return fn(jnp.asarray(sketch, jnp.int32), jnp.asarray(hist, jnp.int32),
+              ops["sketch_ids"], ops["sketch_valid"])
+
+
+@functools.lru_cache(maxsize=8)
 def _dp_lut_builder(mesh: Mesh, bits: int):
     """Build the dp engine's prefix LUT FROM THE PLACED (replicated)
     table, with the output pinned replicated by

@@ -200,6 +200,27 @@ def _spec_wave_builder():
              "k": _CANON["INGEST_K"]})
 
 
+def _spec_sketch_update():
+    """The keyspace observatory's per-wave launch (round 15,
+    ops/sketch.py): one batched scatter-add of the ingest fill target
+    Q=64 ids into the [depth=4, width=2048] count-min sketch + the
+    256-bin top-8-bit keyspace histogram — budgeted from day one so
+    the observability layer's only hot-path device work can't silently
+    fatten (the ISSUE-10 cost-gate requirement)."""
+    import jax
+    import jax.numpy as jnp
+    from .ops.sketch import BINS, SKETCH_DEPTH, SKETCH_WIDTH, sketch_update
+    sketch = jnp.zeros((SKETCH_DEPTH, SKETCH_WIDTH), jnp.int32)
+    hist = jnp.zeros((BINS,), jnp.int32)
+    ids = _queries(_CANON["INGEST_Q"], seed=26)
+
+    def fn(sketch, hist, ids):
+        return sketch_update(sketch, hist, ids)
+    return (jax.jit(fn), (sketch, hist, ids), {},
+            {"Q": _CANON["INGEST_Q"], "depth": SKETCH_DEPTH,
+             "width": SKETCH_WIDTH, "bins": BINS})
+
+
 def _spec_expanded_topk():
     """The window kernel alone (headline bench core, fast3 select)."""
     from .ops.sorted_table import expanded_topk
@@ -392,6 +413,7 @@ def _spec_sharded_maintenance():
 KERNEL_SPECS = {
     "find_closest_nodes_batched": (_spec_find_closest, None),
     "wave_builder_lookup": (_spec_wave_builder, "dht_ingest_wave_seconds"),
+    "sketch_update": (_spec_sketch_update, None),
     "expanded_topk": (_spec_expanded_topk, None),
     "fused_gather_planar": (_spec_fused_gather, None),
     "packed_churn_merge": (_spec_packed_merge, None),

@@ -394,16 +394,40 @@ def _make_handler(server: DhtProxyServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if parts == ["keyspace"]:
+                # GET /keyspace → the keyspace traffic observatory
+                # snapshot (ISSUE-10): 256-bin keyspace histogram,
+                # heavy-hitter top-K with windowed estimates/shares +
+                # hot flags, per-shard load attribution and the
+                # imbalance ratio.  "keyspace" is not a valid hash, so
+                # — like /stats — the path was previously a 400 and
+                # stays unambiguous.
+                # get_keyspace already degrades to {"enabled": False}
+                # on any internal failure — no second wrapper here
+                self._send_json(runner.get_keyspace())
+                return
             if parts[0] == "trace":
-                # GET /trace → the node's flight-recorder dump (ISSUE-4;
-                # the reference's dumpTables as a scrapeable surface);
+                # GET /trace[?name=] → the node's flight-recorder dump
+                # (ISSUE-4; the reference's dumpTables as a scrapeable
+                # surface), name-filterable like the REPL's
+                # `dump [n] [name]` and get_flight_recorder(name=)
+                # (ISSUE-10 satellite: the filter was previously
+                # REPL-only — tr.dump() took no args here);
                 # GET /trace/<id> → one trace's span list, or the
                 # Perfetto-loadable Chrome dump with ?fmt=chrome.
                 # "trace" is not a valid hash, so — like /stats — the
                 # path was previously a 400 and stays unambiguous.
                 tr = tracing.get_tracer()
                 if len(parts) == 1:
-                    self._send_json(tr.dump())
+                    self._send_json(tr.dump(
+                        name=(_q.get("name") or [None])[0]))
+                    return
+                # a malformed (non-hex / oversized) trace id is a 400,
+                # not an empty span list — only a WELL-FORMED unknown
+                # id reports {"spans": []} (ISSUE-10 satellite; the two
+                # cases were previously indistinguishable)
+                if tracing._trace_hex(parts[1]) is None:
+                    self._err(400, "invalid trace id")
                 elif _q.get("fmt", [""])[0] == "chrome":
                     self._send_json(tracing.to_chrome_trace(
                         tr.spans(parts[1])))

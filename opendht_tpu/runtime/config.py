@@ -12,6 +12,7 @@ from typing import Optional
 # re-exported here because runtime/config.py is where node behavior is
 # configured — `Config.health` is the knob surface
 from ..health import HealthConfig, SloObjective, default_slos  # noqa: F401
+from ..keyspace import KeyspaceConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -102,6 +103,19 @@ class Config:
     #: events, and the proxy's readiness route ``GET /healthz``.
     #: ``health.period = 0`` disables the tick entirely.
     health: HealthConfig = field(default_factory=HealthConfig)
+
+    # --- keyspace traffic observatory (round 15, opendht_tpu/keyspace.py) --
+    #: device-resident count-min sketch + 256-bin keyspace histogram
+    #: over the ingest waves' target ids (one batched scatter-add per
+    #: wave) and stored-key puts: periodic heavy-hitter top-K with
+    #: ``hot_key_emerged`` flight events, exponential-decay windowing,
+    #: and per-shard load attribution feeding the ``shard_imbalance``
+    #: health signal, `dht_keyspace_*`/`dht_hotkey_*`/
+    #: `dht_shard_imbalance` gauges, proxy ``GET /keyspace``, the
+    #: `keyspace` REPL cmd and `dhtmon --max-imbalance`.
+    #: ``keyspace.enabled = False`` turns every launch and surface off
+    #: (results are identical either way — the sketch only observes).
+    keyspace: KeyspaceConfig = field(default_factory=KeyspaceConfig)
 
 
 @dataclass

@@ -828,6 +828,21 @@ class DhtRunner:
         rep["enabled"] = True
         return rep
 
+    def get_keyspace(self) -> dict:
+        """The keyspace traffic observatory snapshot (ISSUE-10): the
+        256-bin keyspace histogram, the heavy-hitter top-K with
+        windowed estimates/shares, and the per-shard load attribution
+        + imbalance ratio — the JSON the proxy's ``GET /keyspace``
+        route serves, the ``keyspace`` REPL command prints, and the
+        scanner's ``keyspace`` section embeds."""
+        try:
+            ks = getattr(self._dht, "keyspace", None)
+            if ks is None:
+                return {"enabled": False}
+            return ks.snapshot()
+        except Exception:
+            return {"enabled": False}
+
     def get_trace(self, trace_id) -> list:
         """JSON-able span list of one distributed trace (ISSUE-4): the
         op root span plus every per-hop client span this node sent and

@@ -196,6 +196,12 @@ class WaveBuilder:
         identical per-op launch, when batching is off).  Never sheds —
         admission already happened at the op boundary."""
         if not self.enabled:
+            # escape hatch: the per-op [1] launch — the keyspace
+            # observatory still sees the target (its surfaces must not
+            # go dark when batching is off; results are untouched)
+            ks = getattr(self._dht, "keyspace", None)
+            if ks is not None:
+                ks.observe_hashes([target])
             cb(self._dht.find_closest_nodes_batched([target], af, k)[0])
             return
         now = self._dht.scheduler.time()
@@ -280,6 +286,13 @@ class WaveBuilder:
             results = [[] for _ in entries]
         self.waves += 1
         self._m_waves.inc()
+        # keyspace observatory (ISSUE-10): the wave's [Q] target ids
+        # feed the device count-min sketch + keyspace histogram in ONE
+        # batched scatter-add launch per wave (async dispatch — never
+        # blocks the scatter path; buffered stored-key puts ride along)
+        ks = getattr(self._dht, "keyspace", None)
+        if ks is not None:
+            ks.observe_hashes([e.target for e in entries])
         self._m_occupancy.observe(len(entries))
         for e in entries:
             self._m_queue_s.observe(max(0.0, t_fire - e.t_wall))

@@ -44,7 +44,8 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                min_coverage: Optional[float] = None,
                require_ready: bool = False, op: str = "get",
                sample_max: int = 64, k: int = 8, mesh=None,
-               window: float = 0.0) -> tuple:
+               window: float = 0.0,
+               max_imbalance: Optional[float] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
     is the JSON-able cluster report and ``violations`` is a list of
     human-readable invariant failures (empty = healthy).
@@ -55,11 +56,24 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     move, cluster-side).  The default (0) reads the since-boot
     cumulative ratio — right for a CI smoke's bounded lifetime, wrong
     for a week-old soak, where lifetime counters both hide a fresh
-    outage and remember a recovered one forever (review finding)."""
+    outage and remember a recovered one forever (review finding).
+    ONLY the success/latency invariants window: readiness, the
+    replica-coverage probe and the imbalance gauge are point-in-time
+    by nature, so when no windowed invariant is requested
+    (``min_success`` unset and no ``alerts``) the baseline scrape and
+    the wait are skipped entirely (ISSUE-10 satellite — a
+    coverage-only ``--window`` run used to scrape every node twice
+    for nothing).
+
+    ``max_imbalance`` gates the round-15 keyspace observatory's
+    per-shard load balance: the worst node's ``dht_shard_imbalance``
+    gauge (max/mean per-shard windowed traffic; -1 = unknown, never a
+    violation) must not exceed it."""
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
-    if window > 0 and endpoints:
+    windowed = min_success is not None or bool(alerts)
+    if window > 0 and endpoints and windowed:
         baseline = hm.merge_series([hm.scrape_node(ep)
                                     for ep in endpoints])
         time.sleep(window)
@@ -69,7 +83,7 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     doc: dict = {
         "nodes": [{"endpoint": s["endpoint"], "ready": s["ready"],
                    "verdict": s["verdict"]} for s in scrapes],
-        "window_s": window or None,
+        "window_s": (window or None) if windowed else None,
     }
     if require_ready:
         for s in scrapes:
@@ -97,6 +111,26 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                 lambda q: hm.cluster_quantile(series, op, q), alerts):
             violations.append("cluster %s p%g %.3fs exceeds %.3fs"
                               % (op, pct, v, thr))
+    if max_imbalance is not None and scrapes:
+        # per-node, NOT merged: imbalance ratios don't sum — the gate
+        # is "no node's keyspace is lopsided", so take the worst node
+        # (-1/absent = observatory unknown, never a violation)
+        per_node = []
+        for s in scrapes:
+            vals = [v for name, v in s["series"].items()
+                    if name.startswith("dht_shard_imbalance") and v >= 0]
+            per_node.append({"endpoint": s["endpoint"],
+                             "imbalance": max(vals) if vals else None})
+        known = [p["imbalance"] for p in per_node
+                 if p["imbalance"] is not None]
+        worst = max(known) if known else None
+        doc["shard_imbalance"] = {"max": worst, "per_node": per_node}
+        if worst is not None and worst > max_imbalance:
+            violations.append(
+                "shard imbalance %.3f exceeds %.3f (worst node %s)"
+                % (worst, max_imbalance,
+                   max(per_node, key=lambda p: p["imbalance"] or -1)
+                   ["endpoint"]))
     if runners:
         cov = hm.replica_coverage(runners, sample_max=sample_max, k=k,
                                   mesh=mesh)
@@ -135,12 +169,25 @@ def main(argv=None) -> int:
                    help="op family for the success/latency invariants "
                         "(default: get)")
     p.add_argument("--window", type=float, default=0.0, metavar="SEC",
-                   help="evaluate success/latency over a SEC-second "
-                        "window (scrape, wait, scrape, diff) instead "
-                        "of the since-boot cumulative — use for "
-                        "long-lived clusters, where lifetime ratios "
-                        "hide fresh outages and remember recovered "
-                        "ones")
+                   help="evaluate the SUCCESS/LATENCY invariants over "
+                        "a SEC-second window (scrape, wait, scrape, "
+                        "diff) instead of the since-boot cumulative — "
+                        "use for long-lived clusters, where lifetime "
+                        "ratios hide fresh outages and remember "
+                        "recovered ones.  Readiness, the replica-"
+                        "coverage probe and --max-imbalance are "
+                        "point-in-time and unaffected; with no "
+                        "windowed invariant requested the second "
+                        "scrape is skipped entirely")
+    p.add_argument("--max-imbalance", type=float, default=None,
+                   metavar="R",
+                   help="fail when any node's keyspace shard-load "
+                        "imbalance (dht_shard_imbalance: max/mean "
+                        "per-shard windowed traffic from the count-min "
+                        "observatory) exceeds R — 1.0 is perfect "
+                        "balance, the shard count is a single-shard "
+                        "flood; unknown (no traffic window) never "
+                        "violates")
     p.add_argument("--json", action="store_true",
                    help="emit the full cluster report as one JSON doc")
     args = p.parse_args(argv)
@@ -157,7 +204,7 @@ def main(argv=None) -> int:
         violations, doc = run_checks(
             endpoints, alerts=alerts, min_success=args.min_success,
             require_ready=args.require_ready, op=args.op,
-            window=args.window)
+            window=args.window, max_imbalance=args.max_imbalance)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
         return 2
@@ -175,6 +222,11 @@ def main(argv=None) -> int:
         for name, v in sorted((doc.get("latency") or {}).items()):
             print("cluster %s %s: %s" % (
                 args.op, name, "%.3fs" % v if v is not None else "n/a"))
+        imb = doc.get("shard_imbalance")
+        if imb:
+            print("shard imbalance: %s (worst node)" % (
+                "%.3f" % imb["max"] if imb["max"] is not None
+                else "unknown"))
     for v in violations:
         print("ALERT:", v, file=sys.stderr)
     return 1 if violations else 0

@@ -41,6 +41,14 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            unhealthy) with per-signal and per-SLO
                            burn-rate attribution — the same JSON the
                            proxy serves on GET /healthz
+    keyspace [json]        keyspace traffic observatory (round 15):
+                           heavy-hitter top-K off the device count-min
+                           sketch (windowed estimates, hot flags),
+                           occupied histogram bins, per-shard load
+                           attribution + imbalance ratio — the same
+                           data the proxy serves on GET /keyspace;
+                           'json' dumps the full snapshot (incl. the
+                           256-bin histogram)
     dump [n] [name]        flight-recorder dump: last n (default 40)
                            structured events + span count (the
                            reference's dumpTables analogue); a
@@ -223,6 +231,34 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                     rep.get("verdict", "unknown"),
                     " (causes: %s)" % ", ".join(rep["causes"])
                     if rep.get("causes") else ""))
+            elif op == "keyspace":
+                # keyspace traffic observatory (ISSUE-10): same
+                # snapshot the proxy serves on GET /keyspace
+                import json as _json
+                snap = node.get_keyspace()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("keyspace observatory disabled")
+                else:
+                    print("window %.0f ids (%d lifetime)  occupied bins "
+                          "%d/%d  candidates %d" % (
+                              snap["window_total"], snap["observed_total"],
+                              snap["occupied_bins"], snap["hist_bins"],
+                              snap["candidates"]))
+                    sh = snap["shards"]
+                    print("shards: %s%d  loads %s  imbalance %s" % (
+                        "virtual " if sh["virtual"] else "t=",
+                        sh["n"] if sh["virtual"] else sh["t"],
+                        sh["loads"],
+                        sh["imbalance"] if sh["imbalance"] is not None
+                        else "unknown"))
+                    for t_ in snap["top"]:
+                        print("  %s%s  est %d  share %.1f%%" % (
+                            t_["key"], "  HOT" if t_["hot"] else "",
+                            t_["estimate"], t_["share"] * 100))
+                    if not snap["top"]:
+                        print("  (no traffic observed yet)")
             elif op == "dump":
                 import json as _json
                 n, name = 40, None

@@ -63,8 +63,17 @@ def topology_snapshot(node) -> dict:
         "ingest": {},
         "kernels": {},
         "health": {},
+        "keyspace": {},
         "events": [],
     }
+    try:
+        # round-15 keyspace observatory: heavy hitters, occupied-bin
+        # histogram and per-shard load attribution, so a soak diff
+        # shows WHERE in the ring traffic moved between snapshots (the
+        # full 256-bin histogram rides along — it is 256 ints)
+        snap["keyspace"] = node.get_keyspace()
+    except Exception:
+        pass
     try:
         # round-14 health observatory: the node verdict + per-signal /
         # per-SLO attribution, so a soak diff shows WHEN a node

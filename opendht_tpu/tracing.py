@@ -395,13 +395,18 @@ class Tracer:
 
     def spans(self, trace_id=None) -> List[dict]:
         """Finished spans, optionally filtered to one trace.
-        ``trace_id`` accepts an int, a 32-hex string, or a
-        TraceContext."""
-        want = _trace_hex(trace_id)
+        ``trace_id`` accepts an int, a (up to) 32-hex string, or a
+        TraceContext.  A MALFORMED id (non-hex, oversized — see
+        :func:`_trace_hex`) matches nothing: the caller asked for one
+        trace, so a bogus id must return ``[]``, never the whole
+        ring."""
         out = [r for r in self.records() if "ev" not in r]
-        if want is not None:
-            out = [r for r in out if r["trace_id"] == want]
-        return out
+        if trace_id is None:
+            return out
+        want = _trace_hex(trace_id)
+        if want is None:
+            return []
+        return [r for r in out if r["trace_id"] == want]
 
     def events(self, limit: Optional[int] = None,
                name: Optional[str] = None) -> List[dict]:
@@ -433,13 +438,27 @@ class Tracer:
 
 
 def _trace_hex(trace_id) -> Optional[str]:
+    """Normalize a trace id to its canonical 32-hex form; ``None`` for
+    anything MALFORMED (non-hex characters, > 32 hex digits, empty) —
+    the distinction the proxy's ``GET /trace/<id>`` route needs: a
+    bogus id is a 400, a well-formed unknown id is an empty span list
+    (ISSUE-10 satellite; the old normalization char-stripped ``0``/
+    ``x`` and silently truncated, so both cases looked identical)."""
     if trace_id is None:
         return None
     if isinstance(trace_id, TraceContext):
         return trace_id.trace_hex
     if isinstance(trace_id, int):
-        return "%032x" % trace_id
-    return str(trace_id).lower().lstrip("0x").rjust(32, "0")[-32:]
+        return "%032x" % (trace_id & ((1 << 128) - 1))
+    s = str(trace_id).strip().lower()
+    if s.startswith("0x"):
+        s = s[2:]
+    # charset check, NOT int(s, 16): Python's int() accepts digit-group
+    # underscores and sign prefixes, so 'a_b'/'+ab'/'-1' would pass as
+    # well-formed (review finding)
+    if not s or len(s) > 32 or any(c not in "0123456789abcdef" for c in s):
+        return None
+    return s.rjust(32, "0")
 
 
 # ------------------------------------------------------ chrome trace export

@@ -505,3 +505,60 @@ def test_config_carries_health_and_runner_surfaces():
     r = DhtRunner()             # not started: health surface still sane
     rep = r.get_health()
     assert rep["verdict"] == "unknown" and rep["enabled"] is False
+
+
+# ------------------------------------- dhtmon --window skip + imbalance
+def test_dhtmon_window_skips_second_scrape_when_not_windowed(monkeypatch):
+    """ISSUE-10 satellite: --window only windows the success/latency
+    invariants — when neither is requested (readiness/imbalance/
+    coverage-only runs), the baseline scrape and the wait are skipped
+    (the old path scraped every node twice and slept for nothing)."""
+    from opendht_tpu.tools import dhtmon
+    from opendht_tpu.testing import health_monitor as hm
+
+    calls = []
+    fake = {"ready": True, "verdict": "healthy", "health": {},
+            "series": {'dht_shard_imbalance{node="x"}': 6.5,
+                       'dht_ops_total{ok="true",op="get"}': 10.0}}
+
+    def fake_scrape(ep, timeout=10.0):
+        calls.append(ep)
+        return dict(fake, endpoint=ep)
+
+    slept = []
+    monkeypatch.setattr(hm, "scrape_node", fake_scrape)
+    monkeypatch.setattr(dhtmon.time, "sleep", lambda s: slept.append(s))
+
+    # imbalance-only + window: ONE scrape per endpoint, no sleep, and
+    # the report says the window did not apply
+    v, doc = dhtmon.run_checks(["n1", "n2"], window=5.0,
+                               max_imbalance=5.0)
+    assert len(calls) == 2 and slept == []
+    assert doc["window_s"] is None
+    assert any("imbalance 6.5" in s for s in v)
+    assert doc["shard_imbalance"]["max"] == 6.5
+
+    # a windowed invariant requested: baseline + wait + re-scrape
+    calls.clear()
+    v, doc = dhtmon.run_checks(["n1"], window=5.0, min_success=0.5)
+    assert len(calls) == 2 and slept == [5.0]
+    assert doc["window_s"] == 5.0
+    # windowed diff of identical cumulative scrapes = zero traffic →
+    # success unknown, not a violation
+    assert doc["lookup_success"] is None and v == []
+
+
+def test_dhtmon_imbalance_unknown_never_violates(monkeypatch):
+    from opendht_tpu.tools import dhtmon
+    from opendht_tpu.testing import health_monitor as hm
+    fake = {"ready": True, "verdict": "healthy", "health": {},
+            "series": {'dht_shard_imbalance{node="x"}': -1.0}}
+    monkeypatch.setattr(hm, "scrape_node",
+                        lambda ep, timeout=10.0: dict(fake, endpoint=ep))
+    v, doc = dhtmon.run_checks(["n1"], max_imbalance=1.5)
+    assert v == []
+    assert doc["shard_imbalance"]["max"] is None
+    # a known value over the gate violates, and the worst node is named
+    fake["series"]['dht_shard_imbalance{node="x"}'] = 2.0
+    v, doc = dhtmon.run_checks(["n1"], max_imbalance=1.5)
+    assert len(v) == 1 and "n1" in v[0]

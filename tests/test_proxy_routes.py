@@ -1,0 +1,119 @@
+"""Proxy observability routes (ISSUE-10): GET /keyspace, the
+GET /trace ?name= filter, and the malformed-vs-unknown trace-id
+distinction.  Crypto-free on purpose — unlike tests/test_proxy.py
+(which needs the `cryptography` wheel for its codec/SecureDht halves),
+these routes must stay testable in minimal containers, the same rule
+as the lazy crypto re-exports in opendht_tpu/__init__.py."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opendht_tpu import tracing
+from opendht_tpu.core.value import Value
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.proxy import DhtProxyServer
+from opendht_tpu.runtime.config import NodeStatus
+from opendht_tpu.runtime.runner import DhtRunner
+
+
+def wait_for(pred, timeout=20.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def topology():
+    peer, proxy_node = DhtRunner(), DhtRunner()
+    peer.run(0)
+    proxy_node.run(0)
+    proxy_node.bootstrap("127.0.0.1", peer.get_bound_port())
+    assert wait_for(lambda: peer.get_status() is NodeStatus.CONNECTED
+                    and proxy_node.get_status() is NodeStatus.CONNECTED)
+    server = DhtProxyServer(proxy_node, port=0)
+    yield peer, proxy_node, server
+    server.stop()
+    peer.join()
+    proxy_node.join()
+
+
+def _get(server, path):
+    url = "http://127.0.0.1:%d%s" % (server.port, path)
+    with urllib.request.urlopen(url, timeout=20.0) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_trace_route_name_filter(topology):
+    """ISSUE-10 satellite: GET /trace?name= passes the round-14
+    flight-recorder name filter through (parity with the REPL's
+    `dump [n] [name]` and get_flight_recorder(name=)) — the route
+    previously called tr.dump() with no args."""
+    peer, proxy_node, server = topology
+    tr = tracing.get_tracer()
+    tr.event("proxy_filter_probe_a", marker=1)
+    tr.event("proxy_filter_probe_b", marker=2)
+
+    _code, full = _get(server, "/trace")
+    names = {e["ev"] for e in full["events"]}
+    assert {"proxy_filter_probe_a", "proxy_filter_probe_b"} <= names
+    _code, filt = _get(server, "/trace?name=proxy_filter_probe_a")
+    assert filt["events"], "filtered dump dropped the matching event"
+    assert all(e["ev"] == "proxy_filter_probe_a" for e in filt["events"])
+    # read-side projection: identical to filtering the unfiltered dump
+    # post-hoc (same records, same order)
+    want = [e for e in full["events"] if "proxy_filter_probe_a" in e["ev"]]
+    assert [e["seq"] for e in filt["events"]] == [e["seq"] for e in want]
+    # spans filter too (name substring applies to both record kinds)
+    assert all("proxy_filter_probe_a" in s["name"]
+               for s in filt["spans"])
+
+
+def test_trace_route_malformed_vs_unknown_id(topology):
+    """ISSUE-10 satellite: a malformed trace id is a 400; only a
+    WELL-FORMED unknown id reports an empty span list (the two cases
+    were previously indistinguishable — both silently returned [])."""
+    peer, proxy_node, server = topology
+    base = "http://127.0.0.1:%d/trace/" % server.port
+    for bad in ("zz-not-hex", "0xqqqqqqqq", "a" * 33):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + bad, timeout=20.0)
+        assert ei.value.code == 400, bad
+        assert "invalid trace id" in json.loads(
+            ei.value.read().decode())["err"]
+    # well-formed but unknown: 200 + empty spans
+    code, doc = _get(server, "/trace/" + "f" * 32)
+    assert code == 200 and doc["spans"] == []
+    # chrome format of a well-formed unknown id: empty trace, no error
+    code, doc = _get(server, "/trace/" + "f" * 32 + "?fmt=chrome")
+    assert code == 200 and doc["traceEvents"] == []
+
+
+def test_keyspace_endpoint(topology):
+    """GET /keyspace (ISSUE-10): the observatory snapshot as JSON —
+    traffic driven through the proxy node surfaces in the histogram
+    and (after a tick) the heavy-hitter list."""
+    peer, proxy_node, server = topology
+    key = InfoHash.get("proxy-keyspace-key")
+    assert peer.put_sync(key, Value(b"ks", value_id=81), timeout=20.0)
+    # stride 1 so the handful of gets below deterministically admit
+    # the key into the candidate set regardless of the global sample
+    # phase other tests advanced (production stride is 8)
+    proxy_node._dht.keyspace.cfg.sample_stride = 1
+    for _ in range(6):
+        proxy_node.get_sync(key, timeout=20.0)
+    # force a tick so the snapshot publishes without waiting out the
+    # 2 s production cadence
+    proxy_node._dht.keyspace.tick()
+    code, doc = _get(server, "/keyspace")
+    assert code == 200 and doc["enabled"] is True
+    assert doc["observed_total"] > 0
+    assert len(doc["hist"]) == 256
+    assert "imbalance" in doc["shards"]
+    assert any(t["key"] == key.hex() for t in doc["top"]), doc["top"]

@@ -491,3 +491,64 @@ def test_snapshot_diff():
     # no movement → empty sections
     d2 = telemetry.snapshot_diff(after, after)
     assert d2 == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+# ------------------------------------- name-filtered dump x eviction (sat)
+def test_dump_name_filter_matches_posthoc_under_eviction():
+    """ISSUE-10 satellite: a name-filtered dump taken MID-FLOOD (the
+    ring actively evicting) must equal the unfiltered dump filtered
+    post-hoc — the filter is a read-side projection and can never see
+    records eviction already dropped, nor retain extras."""
+    tr = tracing.Tracer(capacity=64, node="evict-test")
+    # flood 10x capacity with two interleaved event names plus spans
+    for i in range(320):
+        tr.event("keep_me" if i % 3 == 0 else "drop_me", i=i)
+        if i % 7 == 0:
+            tr.record("keep_me.span", float(i), 0.001)
+    full = tr.dump()
+    filt = tr.dump(name="keep_me")
+    want_ev = [e for e in full["events"] if "keep_me" in e["ev"]]
+    want_sp = [s for s in full["spans"] if "keep_me" in s["name"]]
+    assert [e["seq"] for e in filt["events"]] == [e["seq"] for e in want_ev]
+    assert [s["seq"] for s in filt["spans"]] == [s["seq"] for s in want_sp]
+    # eviction really happened: the oldest retained seq is deep into
+    # the flood, and the filtered view starts no earlier
+    total = 320 + len(range(0, 320, 7))
+    oldest = min(r["seq"] for r in tr.records())
+    assert oldest >= total - 64
+    assert filt["events"][0]["seq"] >= oldest
+    # monotone order preserved through filtering
+    seqs = [e["seq"] for e in filt["events"]]
+    assert seqs == sorted(seqs)
+
+
+def test_trace_hex_strict_and_spans_guard():
+    """ISSUE-10 satellite: _trace_hex returns None for malformed ids
+    (non-hex, oversized, empty) and Tracer.spans() with a malformed id
+    returns [] — never the whole ring (the old char-strip
+    normalization made bogus ids look like valid zero-padded ones)."""
+    from opendht_tpu.tracing import _trace_hex
+    assert _trace_hex(None) is None
+    assert _trace_hex("zz") is None
+    assert _trace_hex("") is None
+    assert _trace_hex("a" * 33) is None
+    assert _trace_hex("0x" + "g" * 4) is None
+    # int(s, 16) would accept digit-group underscores and sign
+    # prefixes — these are malformed, not well-formed-unknown (review
+    # finding)
+    assert _trace_hex("a_b") is None
+    assert _trace_hex("+ab") is None
+    assert _trace_hex("-1") is None
+    # well-formed ids normalize to 32 hex digits
+    assert _trace_hex("ab") == "ab".rjust(32, "0")
+    assert _trace_hex("0xAB") == "ab".rjust(32, "0")
+    assert _trace_hex(0xAB) == "%032x" % 0xAB
+    ctx = tracing.TraceContext.new_root()
+    assert _trace_hex(ctx) == ctx.trace_hex
+    tr = tracing.Tracer(capacity=16)
+    tr.record("a-span", 0.0, 0.001)
+    assert len(tr.spans()) == 1                 # unfiltered: everything
+    assert tr.spans("not-hex!") == []           # malformed: nothing
+    assert tr.spans("f" * 32) == []             # well-formed unknown
+    got = tr.spans(tr.records()[0]["trace_id"])
+    assert len(got) == 1                        # well-formed known
