@@ -1,0 +1,172 @@
+"""Hot-cache probe on-cost on the 8192-wave search round (round 16).
+
+The ISSUE-11 acceptance gate: with the hot-value cache ACTIVE (a full
+64-entry device id table) and the batched XOR-compare probe
+(``ops/cache_probe.py``) running over every wave's full ``[W]`` target
+batch — all MISSES, the worst case: the probe buys nothing and every
+target still rides the lookup — the 8192-wave iterative-search round
+must cost < 1% over the cache-free run.  Production probes Q<=64-id
+ingest waves, so this is a far HIGHER duty cycle than the wave builder
+ever pays; a hit only makes the economics better (it removes a whole
+lookup).  Measured with the round-9 paired-delta methodology
+(benchmarks/exp_trace_r9.py) and committed as
+``captures/cache_overhead.json``.
+
+Methodology: both modes run the SAME compiled wave executable,
+interleaved over ``--reps`` trips with the mode order rotating per rep,
+and the committed number is the MEDIAN OF PER-REP PAIRED differences
+(pairing cancels background-load drift on shared hosts).  The driver
+also pins the wave outputs bit-identical between a probed and an
+untouched trip — the "kernels stay bit-identical with the cache
+enabled" acceptance line, checked again in tests/test_hotcache.py.
+
+Usage::
+
+    python benchmarks/exp_cache_r16.py --save     # writes capture
+    python benchmarks/exp_cache_r16.py --smoke    # CI band check
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-N", type=int, default=0,
+                   help="table rows (default: 1M on accelerator, 128K cpu)")
+    p.add_argument("-W", type=int, default=8192, help="wave width")
+    p.add_argument("--reps", type=int, default=15,
+                   help="timed trips per mode (interleaved)")
+    p.add_argument("--save", action="store_true",
+                   help="write captures/cache_overhead.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="assert observed overhead < 5%% (generous CI "
+                        "band; the committed capture documents the "
+                        "tight number against the <1%% acceptance)")
+    args = p.parse_args(argv)
+
+    import jax
+    from opendht_tpu import telemetry
+    from opendht_tpu.core.search import simulate_lookups
+    from opendht_tpu.core.value import Value
+    from opendht_tpu.hotcache import HotCacheConfig, HotValueCache
+    from opendht_tpu.infohash import InfoHash
+    from opendht_tpu.ops.ids import ids_to_bytes
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, sort_table,
+                                              default_lut_bits)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = args.N or (1_000_000 if on_accel else 131_072)
+    W = args.W
+
+    key = jax.random.PRNGKey(16)
+    k1, k2 = jax.random.split(key)
+    table = jax.random.bits(k1, (N, 5), dtype=jax.numpy.uint32)
+    targets = jax.random.bits(k2, (W, 5), dtype=jax.numpy.uint32)
+    sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+    raw = ids_to_bytes(np.asarray(targets))
+    target_hashes = [InfoHash(raw[i].tobytes()) for i in range(W)]
+    eligible = [True] * W
+
+    telemetry.get_registry().enabled = True      # telemetry ON in both modes
+    cache = HotValueCache(HotCacheConfig())
+    # fill the table to capacity with DISJOINT hot keys (deterministic
+    # names, none of them a wave target): every probe is the all-miss
+    # worst case against a full device table
+    cache.on_keyspace_tick([
+        {"_key": bytes(InfoHash.get("cache-r16-hot-%d" % i)),
+         "estimate": 1000 - i, "share": 0.1, "hot": True}
+        for i in range(cache.cfg.capacity)])
+    # on_keyspace_tick admits nothing without local values — seed
+    # entries through offer() instead (the fill-on-get path)
+    for i in range(cache.cfg.capacity):
+        cache.offer(InfoHash.get("cache-r16-hot-%d" % i),
+                    [Value(b"x", value_id=i + 1)])
+    assert cache.active() and \
+        cache.snapshot()["occupancy"] == cache.cfg.capacity
+
+    def trip(mode: str) -> float:
+        t0 = time.perf_counter()
+        out = simulate_lookups(sorted_ids, n_valid, targets, alpha=3,
+                               k=8, lut=lut, state_limbs=2)
+        if mode == "probed":
+            served = cache.probe_wave(target_hashes, eligible)
+            assert not any(v is not None for v in served)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    # shared warmup: one executable serves both modes (and the probe
+    # kernel compiles outside the timed region)
+    for mode in ("probed", "off"):
+        trip(mode)
+
+    # bit-identity: a probed trip and an untouched trip return the same
+    # arrays (the probe is a SEPARATE launch over separate operands —
+    # it never touches the wave computation)
+    base = jax.block_until_ready(simulate_lookups(
+        sorted_ids, n_valid, targets, alpha=3, k=8, lut=lut,
+        state_limbs=2))
+    cache.probe_wave(target_hashes, eligible)
+    probed = jax.block_until_ready(simulate_lookups(
+        sorted_ids, n_valid, targets, alpha=3, k=8, lut=lut,
+        state_limbs=2))
+    for a, b in zip(jax.tree_util.tree_leaves(base),
+                    jax.tree_util.tree_leaves(probed)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "wave outputs diverged with the cache probe enabled"
+    del base, probed
+
+    times: dict = {"off": [], "probed": []}
+    order = ["off", "probed"]
+    for i in range(args.reps):
+        for mode in order[i % 2:] + order[:i % 2]:
+            times[mode].append(trip(mode))
+
+    on_pct = float(np.median([(s - o) / o for s, o in
+                              zip(times["probed"], times["off"])])) * 100
+    med = {m: float(np.median(v) * 1e3) for m, v in times.items()}
+    rec = {
+        "name": "cache_overhead",
+        "value": round(on_pct, 3),
+        "unit": "percent",
+        "acceptance_pct": 1.0,
+        "wave": W, "N": N, "reps": args.reps,
+        "cache_capacity": cache.cfg.capacity,
+        "wave_ms_probed": round(med["probed"], 3),
+        "wave_ms_off": round(med["off"], 3),
+        "platform": jax.devices()[0].platform,
+        "note": "8192-wave search round, median of per-rep paired "
+                "deltas over rotation-interleaved trips: the hot-cache "
+                "probe (one batched XOR-compare launch of the FULL [W] "
+                "target batch against a full %d-entry device table, "
+                "all misses — the worst case, where the probe buys "
+                "nothing) vs no cache; same executable, telemetry on "
+                "in both modes; wave outputs pinned bit-identical"
+                % cache.cfg.capacity,
+    }
+    dc.emit(rec)
+
+    if args.save:
+        dc.write_capture("cache_overhead", rec)
+
+    if args.smoke and on_pct >= 5.0:
+        print("cache-probe overhead %.2f%% exceeds the 5%% smoke band"
+              % on_pct, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -305,9 +305,11 @@ def check_tp_wire(failures):
 
 
 #: overhead-acceptance artifacts (the round-14 health rule, extended
-#: round 15 to the keyspace observatory): each capture must beat its
-#: own recorded acceptance bound, and both docs must state the bound
-_OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead")
+#: round 15 to the keyspace observatory and round 16 to the hot-cache
+#: probe): each capture must beat its own recorded acceptance bound,
+#: and both docs must state the bound
+_OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead",
+                  "cache_overhead")
 
 
 def check_overhead_captures(failures):
@@ -367,7 +369,8 @@ def check_overhead_captures(failures):
 #: PARITY, and every row of that table must name a surface registered
 #: here — adding a surface without registering it fails CI.
 OBS_SURFACES = ("GET /stats", "GET /trace", "GET /healthz",
-                "GET /keyspace", "kernel ledger", "dhtscanner --json")
+                "GET /keyspace", "GET /cache", "kernel ledger",
+                "dhtscanner --json")
 OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /")
 
 

@@ -270,6 +270,42 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "keyspace overhead smoke failed"
 PY
+# hot-cache smoke (round 16): boot a 3-node real-UDP cluster + proxy
+# (node 0 caches, nodes 1-2 cache-off), Zipf-flood the hot key until
+# hot_key_emerged, and assert the observe→act loop closes live: the
+# cache admits the key off the observatory tick, hot gets serve from
+# cache (hit counters advance, wave occupancy attributable to the hot
+# key ~0), the windowed hit ratio reaches >=0.9 with dhtmon
+# --min-cache-hit exiting 0 then 1 under a cold-key miss storm, a
+# fresh put invalidates with the new value visible on every surface
+# (runner ops, proxy REST, listeners), and cache-on == cache-off
+# results throughout.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.cache_smoke import main
+rc = main()
+assert rc == 0, "cache smoke failed"
+PY
+# hot-cache probe overhead smoke (round 16): with the probe running
+# over every wave's full target batch against a full device table (all
+# misses — the worst case), the search round must stay inside a
+# generous 5% band vs the cache-free run (the committed
+# captures/cache_overhead.json documents the tight number against the
+# <1% acceptance, enforced against the README quote by check_docs
+# above).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_cache_r16", pathlib.Path("benchmarks/exp_cache_r16.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "cache overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

@@ -188,6 +188,14 @@ DEFAULT_SIGNAL_THRESHOLDS = {
     # see the field comment.  Unknown below the observatory's
     # min_observed window, so boot noise never trips it.
     "shard_imbalance": (3.0, 6.0),
+    # round 16 (ISSUE-11): the hot-key serving cache's windowed MISS
+    # fraction (1 − dht_cache_hit_ratio) — the engine thresholds on
+    # "bigger is worse", so the signal VALUE is the miss side of the
+    # ratio the gauges/dhtmon report.  Unknown (never trips) while the
+    # cache is disabled, dark, or had no eligible probes in the
+    # window; capped at degraded in the verdict (degrade_only): a cold
+    # cache is an efficiency problem, not a liveness one.
+    "cache_hit_ratio": (0.5, 0.9),
 }
 
 
@@ -222,8 +230,10 @@ class HealthConfig:
     #: searches all land XOR-close to the node's own id, one narrow
     #: ring slice) can exceed the unhealthy threshold for a window on
     #: a perfectly healthy node, and must not 503 its /healthz
-    #: readiness behind a load balancer (review finding)
-    degrade_only: tuple = ("shard_imbalance",)
+    #: readiness behind a load balancer (review finding).
+    #: cache_hit_ratio rides the same cap (round 16): a cold or
+    #: miss-heavy cache degrades efficiency, never liveness.
+    degrade_only: tuple = ("shard_imbalance", "cache_hit_ratio")
 
 
 # ====================================================== window bookkeeping
@@ -618,6 +628,7 @@ class NodeHealth:
                 "ingest_queue": self._ingest_queue,
                 "stale_buckets": self._stale_buckets,
                 "shard_imbalance": self._shard_imbalance,
+                "cache_hit_ratio": self._cache_hit_ratio,
             })
         self._job = None
 
@@ -669,6 +680,22 @@ class NodeHealth:
         ``min_observed`` ids — a quiet node is not imbalanced."""
         ks = getattr(self._dht, "keyspace", None)
         return ks.imbalance() if ks is not None else None
+
+    def _cache_hit_ratio(self) -> Optional[float]:
+        """Windowed MISS fraction of the round-16 hot-key serving
+        cache (``1 − hotcache.hit_ratio()``) — the engine's thresholds
+        compare "bigger is worse", so the signal value is the miss
+        side of the ratio the ``dht_cache_hit_ratio`` gauge and
+        ``dhtmon --min-cache-hit`` report.  None (unknown, never
+        trips) while the cache is disabled/dark or saw no eligible
+        probes in the last observatory window — a quiet cache is not a
+        cold one.  Degrade-only in the verdict
+        (:class:`HealthConfig`.degrade_only)."""
+        hc = getattr(self._dht, "hotcache", None)
+        if hc is None:
+            return None
+        ratio = hc.hit_ratio()
+        return None if ratio is None else 1.0 - ratio
 
     # --------------------------------------------------------------- tick
     def attach(self, scheduler) -> None:

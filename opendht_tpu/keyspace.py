@@ -240,6 +240,10 @@ class KeyspaceObservatory:
         self._hist_host = np.zeros((BINS,), np.int64)
         self._job = None
         self._m_obs: Dict[str, object] = {}      # source -> counter
+        # tick subscribers (ISSUE-11): the hot-key serving cache (and
+        # anything else acting on the observatory's products) receives
+        # each tick's heavy-hitter list — the observe→act seam
+        self._subscribers: List[Callable] = []
 
     # ------------------------------------------------------------- device
     def _ensure_device(self) -> bool:
@@ -414,6 +418,24 @@ class KeyspaceObservatory:
                 kept[kb] = hits
         self._candidates = kept
 
+    # -------------------------------------------------------- subscribers
+    def subscribe(self, cb: Callable[[List[dict]], None]) -> None:
+        """Register a tick subscriber (ISSUE-11): ``cb(top)`` fires
+        after every tick that (re)publishes the heavy-hitter list —
+        ``top`` entries carry the canonical ``_key`` bytes alongside
+        the public fields, so an acting layer (the hot-value cache) can
+        key device state off them.  A dark/disabled tick notifies with
+        an empty list so subscribers narrow/evict instead of holding a
+        stale hot set."""
+        self._subscribers.append(cb)
+
+    def _notify(self, top: List[dict]) -> None:
+        for cb in self._subscribers:
+            try:
+                cb(top)
+            except Exception:
+                log.exception("keyspace tick subscriber failed")
+
     # --------------------------------------------------------------- tick
     def attach(self, scheduler) -> None:
         """Arm the periodic tick on the node scheduler (decay, heavy-
@@ -478,9 +500,19 @@ class KeyspaceObservatory:
             hist = self._hist
         if dark:
             self._export_gauges()       # gauges flip to unknown (-1)
+            self._notify([])            # subscribers drop the hot set
             return self.snapshot()
         if not dirty:
             self._export_gauges()
+            # quiet ticks still notify subscribers with the retained
+            # top (ISSUE-11 review finding): the acting layers' windows
+            # must roll and their TTL sweeps must run on an idle node —
+            # a frozen hit-ratio window would hold the degrade-only
+            # health signal (and dhtmon --min-cache-hit) on a stale
+            # low ratio forever
+            with self._lock:
+                top = list(self._top)
+            self._notify(top)
             return self.snapshot()
         # ---- heavy hitters: candidate re-score, ONE batched query
         top: List[dict] = []
@@ -494,6 +526,7 @@ class KeyspaceObservatory:
                 with self._lock:
                     self._go_dark_locked()
                 self._export_gauges()   # gauges flip to unknown (-1)
+                self._notify([])        # subscribers drop the hot set
                 return self.snapshot()
             order = np.argsort(-est, kind="stable")[:self.cfg.top_k]
             wt = max(wt_seen, 1.0)
@@ -560,7 +593,12 @@ class KeyspaceObservatory:
                             self._candidates[kb] = hits
                         else:
                             del self._candidates[kb]
+            went_dark = self._device_ok is False
         self._export_gauges()
+        # acting layers (the hot-value cache) see the SAME top list the
+        # snapshot publishes — or an empty one if the decay launch went
+        # dark (the published products were cleared with it)
+        self._notify([] if went_dark else top)
         return self.snapshot()
 
     def _shard_edges(self) -> Tuple[int, List[float], bool]:

@@ -196,6 +196,12 @@ TABLE_AXIS_RULES = (
     # over the table axis — each shard builds a partial sketch, one
     # psum pair merges (sharded.py sharded_sketch_update)
     (r"sketch_ids$", P("t", None)),
+    # hot-cache probe traffic (ISSUE-11): the wave's probe targets
+    # split over the table axis — the tiny [C, 5] cache table rides
+    # replicated, each shard XOR-compares its target rows locally
+    # (sharded.py sharded_cache_probe; fully data-parallel, no
+    # collective)
+    (r"probe_ids$", P("t", None)),
     (r"targets$|queries$", P("q", None)),
     (r".*", P()),
 )

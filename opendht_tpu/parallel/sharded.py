@@ -628,6 +628,55 @@ def sharded_sketch_update(mesh: Mesh, sketch, hist, ids):
 
 
 @functools.lru_cache(maxsize=8)
+def _build_sharded_cache_probe(mesh: Mesh, capacity: int):
+    def local(cache_ids, valid, targets):
+        # each shard XOR-compares ITS slice of the wave's targets
+        # against the replicated [C, 5] cache table — all-limb equality
+        # == XOR distance exactly zero, the ops/cache_probe.py compare,
+        # fully data-parallel (no collective: outputs stay t-split and
+        # the caller gathers)
+        t = targets.astype(_U32)
+        c = cache_ids.astype(_U32)
+        eq = jnp.all(t[:, None, :] == c[None, :, :], axis=-1) \
+            & valid[None, :]
+        hit = jnp.any(eq, axis=1)
+        slot = jnp.where(hit, jnp.argmax(eq, axis=1).astype(jnp.int32),
+                         jnp.int32(-1))
+        return hit, slot
+
+    fn = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P("t", None)),
+        out_specs=(P("t"), P("t")),
+        **_SM_KW,
+    )
+    return jax.jit(fn)
+
+
+def sharded_cache_probe(mesh: Mesh, cache_ids, valid, targets):
+    """tp twin of :func:`opendht_tpu.ops.cache_probe.cache_probe`
+    (ISSUE-11): the wave's probe targets ROW-SPLIT over the ``t`` axis
+    against the replicated cache table, each shard answering its slice
+    locally — zero collectives (membership is per-target), so the twin
+    costs exactly the single-device compare divided by t.  Ragged
+    widths pad (pad rows' answers are sliced off host-side), so any Q
+    works.
+
+    Returns host ``(hit [Q] bool, slot [Q] int32)``, BIT-IDENTICAL to
+    the single-device probe over the same targets (pinned in
+    tests/test_hotcache.py)."""
+    t_np = np.asarray(targets, np.uint32).reshape(-1, N_LIMBS)
+    n_t = mesh.shape["t"]
+    padded, n = pad_to_multiple(t_np, n_t)
+    fn = _build_sharded_cache_probe(mesh, int(cache_ids.shape[0]))
+    ops = shard_put(mesh, {"probe_ids": padded}, TABLE_AXIS_RULES)
+    hit, slot = fn(jnp.asarray(cache_ids, _U32),
+                   jnp.asarray(np.asarray(valid, bool)),
+                   ops["probe_ids"])
+    return np.asarray(hit)[:n], np.asarray(slot)[:n]
+
+
+@functools.lru_cache(maxsize=8)
 def _dp_lut_builder(mesh: Mesh, bits: int):
     """Build the dp engine's prefix LUT FROM THE PLACED (replicated)
     table, with the output pinned replicated by

@@ -221,6 +221,27 @@ def _spec_sketch_update():
              "width": SKETCH_WIDTH, "bins": BINS})
 
 
+def _spec_cache_probe():
+    """The hot-cache membership probe (round 16, ops/cache_probe.py):
+    one batched XOR-compare of the ingest fill target Q=64 wave
+    targets against the default-capacity [64, 5] cache id table — the
+    launch ``runtime/wave_builder.py _serve_cached`` runs BEFORE every
+    lookup launch, budgeted from day one so the fast path's only new
+    device work can't silently fatten (the ISSUE-11 cost-gate
+    requirement)."""
+    import jax
+    import jax.numpy as jnp
+    from .ops.cache_probe import CACHE_CAPACITY, cache_probe
+    cache_ids = _queries(CACHE_CAPACITY, seed=27)
+    valid = jnp.ones((CACHE_CAPACITY,), bool)
+    targets = _queries(_CANON["INGEST_Q"], seed=28)
+
+    def fn(cache_ids, valid, targets):
+        return cache_probe(cache_ids, valid, targets)
+    return (jax.jit(fn), (cache_ids, valid, targets), {},
+            {"Q": _CANON["INGEST_Q"], "C": CACHE_CAPACITY})
+
+
 def _spec_expanded_topk():
     """The window kernel alone (headline bench core, fast3 select)."""
     from .ops.sorted_table import expanded_topk
@@ -414,6 +435,7 @@ KERNEL_SPECS = {
     "find_closest_nodes_batched": (_spec_find_closest, None),
     "wave_builder_lookup": (_spec_wave_builder, "dht_ingest_wave_seconds"),
     "sketch_update": (_spec_sketch_update, None),
+    "cache_probe": (_spec_cache_probe, None),
     "expanded_topk": (_spec_expanded_topk, None),
     "fused_gather_planar": (_spec_fused_gather, None),
     "packed_churn_merge": (_spec_packed_merge, None),

@@ -406,6 +406,16 @@ def _make_handler(server: DhtProxyServer):
                 # on any internal failure — no second wrapper here
                 self._send_json(runner.get_keyspace())
                 return
+            if parts == ["cache"]:
+                # GET /cache → the hot-key serving cache snapshot
+                # (ISSUE-11): occupancy, per-entry hit counts, windowed
+                # hit ratio, invalidations and the widened hot set.
+                # "cache" is not a valid hash, so — like /stats — the
+                # path was previously a 400 and stays unambiguous.
+                # get_cache already degrades to {"enabled": False} on
+                # any internal failure — no second wrapper here
+                self._send_json(runner.get_cache())
+                return
             if parts[0] == "trace":
                 # GET /trace[?name=] → the node's flight-recorder dump
                 # (ISSUE-4; the reference's dumpTables as a scrapeable

@@ -13,6 +13,7 @@ from typing import Optional
 # configured — `Config.health` is the knob surface
 from ..health import HealthConfig, SloObjective, default_slos  # noqa: F401
 from ..keyspace import KeyspaceConfig  # noqa: F401  (same knob-surface rule)
+from ..hotcache import HotCacheConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -116,6 +117,22 @@ class Config:
     #: ``keyspace.enabled = False`` turns every launch and surface off
     #: (results are identical either way — the sketch only observes).
     keyspace: KeyspaceConfig = field(default_factory=KeyspaceConfig)
+
+    # --- hot-key serving cache (round 16, opendht_tpu/hotcache.py) ----
+    #: the acting half of the observe→act loop: a bounded device table
+    #: of the observatory's hot keys (canonical 20-byte ids) + host
+    #: value payloads, probed in ONE batched XOR-compare launch before
+    #: every ingest wave so hot gets are served from cache without
+    #: joining the ``[Q]`` lookup launch, invalidated on observed puts
+    #: (a put is visible on the next get, never a stale hit), plus
+    #: adaptive replica widening (closest-8 → closest-16 while a key is
+    #: hot, narrowing on decay).  Surfaces: ``dht_cache_*`` series +
+    #: hit ratio on ``GET /stats``/``get_metrics()``, proxy
+    #: ``GET /cache``, the ``cache`` REPL cmd, ``dhtmon
+    #: --min-cache-hit`` and a degrade-only ``cache_hit_ratio`` health
+    #: signal.  ``cache.enabled = False`` turns the probe, fast path
+    #: and widening off — results are pinned identical either way.
+    cache: HotCacheConfig = field(default_factory=HotCacheConfig)
 
 
 @dataclass

@@ -192,6 +192,20 @@ class Dht:
             shard_info=self._keyspace_shard_info)
         self.keyspace.attach(self.scheduler)
 
+        # hot-key serving cache (round 16, ISSUE-11): the acting half
+        # of the observe→act loop — subscribes to the observatory tick,
+        # keeps a bounded device table of the hot keys' ids (probed in
+        # one batched XOR-compare launch before every ingest wave) +
+        # host value payloads, and answers the adaptive replica-k
+        # question for the announce/republish paths (hotcache.py;
+        # config.cache knobs)
+        from ..hotcache import HotValueCache
+        self.hotcache = HotValueCache(
+            getattr(config, "cache", None), node=str(self.myid),
+            local_values=lambda kb: self.get_local(InfoHash(kb)),
+            clock=self.scheduler.time)
+        self.keyspace.subscribe(self.hotcache.on_keyspace_tick)
+
         # t-sharded resolve (round 13): lazily-built (q=1, t) mesh from
         # config.resolve_mesh_t; None until first use, False = probed
         # and unavailable (fewer devices than requested / no jax).
@@ -569,18 +583,85 @@ class Dht:
         instead of paying a per-search padded launch; the nodes land via
         :meth:`_refill_apply` and the search re-steps itself.  The
         ``ingest_batching="off"`` path below is byte-for-byte the
-        pre-round-12 per-op dispatch."""
+        pre-round-12 per-op dispatch.
+
+        Round 16 (ISSUE-11): a PURE-GET refill is cache-eligible — the
+        wave builder probes the hot-value cache in one batched
+        XOR-compare launch before ``_launch`` and a hit completes the
+        get via :meth:`_refill_cache_hit` without the search ever
+        joining the ``[Q]`` lookup; the batching-off path takes the
+        identical decision through the host-side membership test
+        (``hotcache.serve_one``)."""
         now = self.scheduler.time()
         sr.refill_time = now
+        cacheable = self._cache_eligible(sr)
         if self.wave_builder.enabled:
             if not sr.refill_pending:
                 sr.refill_pending = True
                 self.wave_builder.submit(
                     sr.id, sr.af, SEARCH_NODES,
-                    lambda nodes, _sr=sr: self._refill_apply(_sr, nodes))
+                    lambda nodes, _sr=sr: self._refill_apply(_sr, nodes),
+                    cache_cb=(lambda values, _sr=sr:
+                              self._refill_cache_hit(_sr, values))
+                    if cacheable else None)
             return 0
+        if cacheable:
+            vals = self.hotcache.serve_one(sr.id)
+            if vals is not None:
+                self.keyspace.observe_hashes([sr.id], source="cache")
+                self._refill_cache_hit(sr, vals)
+                return 0
         return self._refill_insert(
             sr, self.find_closest_nodes(sr.id, sr.af, SEARCH_NODES))
+
+    def _cache_eligible(self, sr: Search) -> bool:
+        """Only PURE-GET searches may be served from the hot-value
+        cache: an announce needs real closest nodes to put to, a listen
+        needs live subscriptions, and a field query projects server-
+        side — all of those always ride the wave.  Pinned result-
+        equivalent cache-on vs cache-off in tests/test_hotcache.py."""
+        hc = self.hotcache
+        if hc is None or not hc.enabled:
+            return False
+        if sr.announce or sr.listeners or not sr.callbacks:
+            return False
+        return all(g.get_cb is not None and g.query_cb is None
+                   for g in sr.callbacks)
+
+    def _refill_cache_hit(self, sr: Search, values: List[Value]) -> None:
+        """Serve a cache-eligible search from the hot-value cache: the
+        cached values complete every pending get (through its own
+        filter) exactly as :meth:`_search_step`'s completed-get block
+        would, without the search joining a lookup launch.  The search
+        object stays reusable — a later op on the same key re-opens it
+        through the normal path.
+
+        Eligibility is RE-CHECKED here: it was decided at submit time,
+        and an announce/listen can join the search while the refill sat
+        in the wave queue — swallowing that refill would leave the
+        search with zero candidates and the put/listen would expire
+        unserved (review finding).  A no-longer-eligible search falls
+        through to the normal refill path instead."""
+        sr.refill_pending = False
+        if not self._cache_eligible(sr):
+            self._refill(sr)
+            if not sr.expired and not sr.done:
+                self._edit_step(sr, self.scheduler.time())
+            return
+        completed = list(sr.callbacks)
+        for get in completed:
+            vals = [v for v in values
+                    if get.filter is None or get.filter(v)]
+            if get.get_cb and vals:
+                get.get_cb(vals)
+            sr.set_get_done(get)
+            sr.callbacks.remove(get)
+        for get in completed:
+            for sn in sr.nodes:
+                sn.get_status.pop(get.query, None)
+                sn.pagination_queries.pop(get.query, None)
+        if not sr.callbacks and not sr.announce and not sr.listeners:
+            sr.set_done()
 
     def _refill_insert(self, sr: Search, nodes: List[Node]) -> int:
         now = self.scheduler.time()
@@ -824,13 +905,32 @@ class Dht:
             self._edit_step(sr, self.scheduler.time())
 
     # ----------------------------------------------------------- announce path
+    def _replica_k(self, key: InfoHash) -> int:
+        """Adaptive replica set for ``key`` (ISSUE-11): closest-16
+        while the key is in the hot-cache's hot set (widening relieves
+        the storing-node bottleneck the way Kademlia §4.1 prescribes),
+        closest-8 otherwise — and back to 8 the tick after the key
+        decays out.  Consulted by the announce walk and the
+        calendar-binned republish resolve; pinned vs a scalar oracle
+        in tests/test_hotcache.py."""
+        return self.hotcache.replica_k(key)
+
     @_traced_search
     def _search_send_announce(self, sr: Search) -> None:
         """Probe synced nodes with SELECT id,seq then put/refresh
-        (↔ Dht::searchSendAnnounceValue, src/dht.cpp:380-485)."""
+        (↔ Dht::searchSendAnnounceValue, src/dht.cpp:380-485).
+
+        Round 16: the replica walk counts to :meth:`_replica_k` (8, or
+        16 for hot keys) instead of the fixed TARGET_NODES, and the
+        search's candidate capacity widens by the same margin so the
+        wider walk has candidates to reach — both re-evaluated per call,
+        so a key decaying out of the hot set narrows automatically."""
         if not sr.announce:
             return
         now = self.scheduler.time()
+        rk = self._replica_k(sr.id)
+        sr.capacity = max(SEARCH_NODES,
+                          rk + (SEARCH_NODES - TARGET_NODES))
         probe_query = Query(Select().field(Field.ID).field(Field.SEQ_NUM))
         i = 0
         for sn in sr.nodes:
@@ -846,7 +946,7 @@ class Dht:
                 # per routing_table.h:26)
                 if not sn.candidate:
                     i += 1
-                    if i == TARGET_NODES:
+                    if i == rk:
                         break
                 continue
 
@@ -911,7 +1011,7 @@ class Dht:
                 self._mk_get_expired(sr, probe_query))
             if not sn.candidate:
                 i += 1
-                if i == TARGET_NODES:
+                if i == rk:
                     break
 
     def _on_announce_done(self, node: Node, answer: RequestAnswer,
@@ -997,6 +1097,10 @@ class Dht:
         log.debug("[search %s] get", key, extra={"dht_hash": bytes(key)})
         q = Query(Select(), where or Where())
         f = Filters.chain(f, q.where.get_filter())
+        # captured BEFORE the search starts: an invalidation landing
+        # while this get is in flight bumps the key's token and the
+        # fill-on-get offer below is rejected (freshness)
+        offer_token = self.hotcache.offer_token(key)
         # done when the user stops us or both family searches finish;
         # ok = user-stop or either search completing (dht.cpp:952-978)
         state = {"done": False, "stop": False, "done4": False, "done6": False,
@@ -1008,6 +1112,22 @@ class Dht:
                 return
             if state["stop"] or (state["done4"] and state["done6"]):
                 state["done"] = True
+                # fill-on-get (ISSUE-11, the Kademlia lookup-path
+                # caching move): a completed get on a currently-hot,
+                # not-yet-cached key seeds the hot-value cache with the
+                # observed value set — the next hot get serves from it.
+                # ONLY unfiltered gets may seed: a where/user filter
+                # makes state["values"] a SUBSET of the key's value
+                # set, and caching it would drop values from later
+                # unfiltered gets (review finding).  The offer token
+                # rejects a seed whose key was invalidated by a put
+                # while this get was in flight — the stale pre-put set
+                # must not re-enter through the fill path (review
+                # finding).
+                if state["values"] and f is None \
+                        and self.hotcache.wants(key):
+                    self.hotcache.offer(key, list(state["values"]),
+                                        token=offer_token)
                 if done_cb:
                     done_cb(state["stop"] or state["ok4"] or state["ok6"],
                             state["nodes"])
@@ -1039,15 +1159,21 @@ class Dht:
                 maybe_done(nodes)
             return cb
 
+        # preset non-running families FIRST (the put() discipline): a
+        # cache-served get completes SYNCHRONOUSLY inside _search on
+        # the batching-off path (round 16), and its done callback must
+        # see the final flag state or the op never reports done
         ran = False
-        for af, flag, ok_flag in ((_socket.AF_INET, "done4", "ok4"),
-                                  (_socket.AF_INET6, "done6", "ok6")):
+        families = ((_socket.AF_INET, "done4", "ok4"),
+                    (_socket.AF_INET6, "done6", "ok6"))
+        for af, flag, _ok in families:
+            if not self.is_running(af):
+                state[flag] = True
+        for af, flag, ok_flag in families:
             if self.is_running(af):
                 ran = True
                 self._search(key, af, get_cb=gcb,
                              done_cb=mk_done(flag, ok_flag), f=f, q=q)
-            else:
-                state[flag] = True
         if not ran:
             maybe_done([])
 
@@ -1113,6 +1239,11 @@ class Dht:
             return
         if value.id == Value.INVALID_ID:
             value.id = random_value_id()
+        # freshness (ISSUE-11): invalidate BEFORE the announce, even
+        # when the local store rejects the value (full/over-quota) —
+        # the put is still propagating to the network, and a stale
+        # cache hit must not outlive it
+        self.hotcache.invalidate(key)
         state = {"done": False, "done4": False, "done6": False,
                  "ok4": False, "ok6": False}
 
@@ -1377,6 +1508,11 @@ class Dht:
             # traffic too — buffered host-side, flushed into the next
             # wave's one scatter-add launch (never a launch of its own)
             self.keyspace.note_stored(key)
+            # hot-cache freshness (ISSUE-11): an observed put — local
+            # API put or incoming announce — invalidates the cached
+            # entry, so the NEXT get takes the full path and can never
+            # be served the stale value set
+            self.hotcache.invalidate(key)
             if self.total_store_size > self.max_store_size:
                 self._expire_store_all()
             self._storage_changed(key, st, vs.data, diff.values_diff > 0)
@@ -1437,6 +1573,9 @@ class Dht:
         self.total_store_size += size_diff
         self.total_values -= len(expired)
         if expired:
+            # a cached entry may hold the just-expired values; drop it
+            # (the tick re-admits from the store's surviving set)
+            self.hotcache.invalidate(key)
             vids = [v.id for v in expired]
             for node, node_listeners in list(st.listeners.items()):
                 for sid in node_listeners:
@@ -1547,7 +1686,8 @@ class Dht:
             return
         self._storage_maintenance_batched([key])
 
-    def _republish_predicate(self, keys: List[InfoHash], af: int
+    def _republish_predicate(self, keys: List[InfoHash], af: int,
+                             ks: Optional[List[int]] = None
                              ) -> List[bool]:
         """The "no longer among the k closest" test for MANY keys from
         ONE batched closest-k resolve (↔ the per-key
@@ -1558,16 +1698,26 @@ class Dht:
         same `< 0` strictness on ties; pinned in
         tests/test_maintenance.py) — including tables smaller than k
         (the last VALID row, not the padded k-th) and empty tables
-        (no nodes ⇒ no republish, family keeps responsibility)."""
+        (no nodes ⇒ no republish, family keeps responsibility).
+
+        ``ks`` (round 16) is the per-key replica set from
+        :meth:`_replica_k` — the ONE resolve runs at ``max(ks)`` and
+        each key's decision reads the last servable row WITHIN its own
+        first ``ks[i]`` columns (the top-k prefix of a wider top-k is
+        the narrower top-k, so a uniform ks == [8]*n is bit-identical
+        to the pre-round-16 path — hot keys widen to 16 without a
+        second launch)."""
         table = self._table(af)
         out = [False] * len(keys)
         if table is None or len(table) == 0 or not keys:
             return out
-        rows, _dist = table.find_closest(list(keys), k=TARGET_NODES,
+        if ks is None:
+            ks = [TARGET_NODES] * len(keys)
+        rows, _dist = table.find_closest(list(keys), k=max(ks),
                                          now=self.scheduler.time())
         last_rows = np.full(len(keys), -1, dtype=np.int64)
         for qi in range(rows.shape[0]):
-            for j in range(rows.shape[1] - 1, -1, -1):
+            for j in range(min(ks[qi], rows.shape[1]) - 1, -1, -1):
                 r = int(rows[qi, j])
                 if r >= 0 and table.addr_of(r) is not None:
                     last_rows[qi] = r
@@ -1595,8 +1745,17 @@ class Dht:
         announced = 0
         still = {bytes(k): {af: True for af in self.tables} for k in keys}
         reg = telemetry.get_registry()
+        # adaptive replica widening (ISSUE-11): keys in the hot set
+        # resolve/replicate at closest-16, the rest at closest-8 — ONE
+        # launch per family either way (the predicate resolves at
+        # max(ks) and reads each key's own k-prefix), riding the same
+        # calendar bins
+        ks = [self._replica_k(k) for k in keys]
+        widened = sum(1 for k_i in ks if k_i > TARGET_NODES)
+        if widened:
+            reg.counter("dht_cache_republish_widened_total").inc(widened)
         with reg.span("dht_maintenance_republish_seconds"):
-            republish = {af: self._republish_predicate(keys, af)
+            republish = {af: self._republish_predicate(keys, af, ks)
                          for af in self.tables}
         # re-schedule EVERY key before the announce fan-out: a raising
         # callback mid-announce must not silently end the whole due
@@ -1746,9 +1905,14 @@ class Dht:
             raise DhtProtocolException(DhtProtocolException.UNAUTHORIZED,
                                        DhtProtocolException.PUT_WRONG_TOKEN)
         # store only if we're plausibly among the SEARCH_NODES closest
-        # (src/dht.cpp:2290-2298) — one batched device call
+        # (src/dht.cpp:2290-2298) — one batched device call.  Keys hot
+        # in THIS node's observatory skip the too-far rejection
+        # (ISSUE-11): the widened closest-16 announce fan-out reaches
+        # nodes past the closest-8, and refusing their stores would
+        # defeat the replica widening the hot set asked for.
         table = self._table(node.family)
-        if table is not None and len(table) > 0:
+        if table is not None and len(table) > 0 \
+                and not self.hotcache.is_hot(key):
             rows, _ = table.find_closest([key], k=SEARCH_NODES,
                                          now=self.scheduler.time())
             rows = rows[0][rows[0] >= 0]

@@ -296,6 +296,11 @@ class Search:
         self.trace_ctx = None
         self.expired = False
         self.done = False
+        #: candidate-set bound (ISSUE-11): SEARCH_NODES normally; the
+        #: announce path widens it for keys in the hot set so a
+        #: closest-16 replica walk has candidates to walk (narrowed
+        #: back on decay — Dht._search_send_announce re-evaluates it)
+        self.capacity = SEARCH_NODES
         self.nodes: List[SearchNode] = []
         self.announce: List[Announce] = []
         self.callbacks: List[Get] = []           # kept in start-time order
@@ -335,15 +340,16 @@ class Search:
 
         new_node = False
         if not found:
+            cap = self.capacity
             bad = 0
             if self.expired:
-                full = len(self.nodes) >= SEARCH_NODES
-                trim_at = SEARCH_NODES if full else len(self.nodes)
+                full = len(self.nodes) >= cap
+                trim_at = cap if full else len(self.nodes)
             else:
                 bad = self.get_number_of_bad_nodes()
-                full = len(self.nodes) - bad >= SEARCH_NODES
+                full = len(self.nodes) - bad >= cap
                 trim_at = len(self.nodes)
-                while trim_at - bad > SEARCH_NODES:
+                while trim_at - bad > cap:
                     trim_at -= 1
                     if self.nodes[trim_at].is_bad():
                         bad -= 1
@@ -365,7 +371,7 @@ class Search:
             elif self.expired:
                 bad = len(self.nodes) - 1
                 self.expired = False
-            while len(self.nodes) - bad > SEARCH_NODES:
+            while len(self.nodes) - bad > cap:
                 if not self.expired and self.nodes[-1].is_bad():
                     bad -= 1
                 self.nodes.pop()

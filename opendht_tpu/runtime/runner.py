@@ -843,6 +843,20 @@ class DhtRunner:
         except Exception:
             return {"enabled": False}
 
+    def get_cache(self) -> dict:
+        """The hot-key serving cache snapshot (ISSUE-11): occupancy,
+        per-entry hit counts, windowed hit ratio, invalidation/eviction
+        totals and the current widened hot set — the JSON the proxy's
+        ``GET /cache`` route serves, the ``cache`` REPL command prints,
+        and the scanner's ``cache`` section embeds."""
+        try:
+            hc = getattr(self._dht, "hotcache", None)
+            if hc is None:
+                return {"enabled": False}
+            return hc.snapshot()
+        except Exception:
+            return {"enabled": False}
+
     def get_trace(self, trace_id) -> list:
         """JSON-able span list of one distributed trace (ISSUE-4): the
         op root span plus every per-hop client span this node sent and

@@ -91,10 +91,11 @@ class _Entry:
     cross-node assembler's monotonicity check)."""
 
     __slots__ = ("target", "af", "k", "cb", "t_enq", "t_wall", "ctx",
-                 "kind", "retries")
+                 "kind", "retries", "cache_cb")
 
     def __init__(self, target: InfoHash, af: int, k: int, cb: Callable,
-                 t_enq: float, t_wall: float, ctx, kind: str):
+                 t_enq: float, t_wall: float, ctx, kind: str,
+                 cache_cb: "Callable | None" = None):
         self.target = target
         self.af = af
         self.k = k
@@ -104,6 +105,10 @@ class _Entry:
         self.ctx = ctx
         self.kind = kind
         self.retries = 0              # failed-launch re-queues so far
+        # round 16 (ISSUE-11): non-None marks a CACHE-ELIGIBLE entry (a
+        # pure-get refill) — a hot-cache probe hit calls cache_cb(values)
+        # and the entry never joins the lookup launch
+        self.cache_cb = cache_cb
 
 
 class WaveBuilder:
@@ -190,11 +195,16 @@ class WaveBuilder:
 
     # ------------------------------------------------------------- ingest
     def submit(self, target: InfoHash, af: int, k: int,
-               cb: Callable[[List], None], *, kind: str = "refill") -> None:
+               cb: Callable[[List], None], *, kind: str = "refill",
+               cache_cb: "Callable | None" = None) -> None:
         """Queue one closest-``k`` lookup for ``target``; ``cb(nodes)``
         fires from the wave that carries it (immediately, with the
         identical per-op launch, when batching is off).  Never sheds —
-        admission already happened at the op boundary."""
+        admission already happened at the op boundary.
+
+        ``cache_cb`` (round 16) marks the entry cache-eligible: the
+        pre-launch hot-cache probe may serve it values instead of nodes
+        (``_serve_cached``), in which case it never joins the launch."""
         if not self.enabled:
             # escape hatch: the per-op [1] launch — the keyspace
             # observatory still sees the target (its surfaces must not
@@ -206,7 +216,7 @@ class WaveBuilder:
             return
         now = self._dht.scheduler.time()
         self._pending.append(_Entry(target, af, k, cb, now, _time.time(),
-                                    tracing.current(), kind))
+                                    tracing.current(), kind, cache_cb))
         depth = len(self._pending)
         self._m_depth.set(depth)
         c = self._m_ops.get(kind)
@@ -239,18 +249,59 @@ class WaveBuilder:
     # --------------------------------------------------------------- waves
     def _fire(self) -> None:
         """Drain the queue into one launch per (family, k) group and
-        scatter results.  Runs as a scheduler job on the DHT thread."""
+        scatter results.  Runs as a scheduler job on the DHT thread.
+        Round 16: the hot-cache probe peels cache hits off the batch
+        FIRST (one XOR-compare launch over the whole wave), so a hot
+        get never joins the ``[Q]`` lookup launch at all."""
         self._job = None
         if not self._pending:
             return
         batch = list(self._pending)
         self._pending.clear()
         self._m_depth.set(0)
+        batch = self._serve_cached(batch)
+        if not batch:
+            return
         groups: dict = {}
         for e in batch:
             groups.setdefault((e.af, e.k), []).append(e)
         for (af, k), entries in groups.items():
             self._launch(af, k, entries)
+
+    def _serve_cached(self, entries: List[_Entry]) -> List[_Entry]:
+        """The serve-from-cache fast path (ISSUE-11): ONE batched
+        XOR-compare launch (``ops/cache_probe.py``) over the wave's
+        targets against the hot-value cache's device id table.  Hits
+        on CACHE-ELIGIBLE entries (pure-get refills — ``cache_cb`` set)
+        are served host-side values and removed from the wave; misses
+        and ineligible entries fall through unchanged.  Served targets
+        still feed the keyspace observatory (source="cache") — a
+        cache-served key must stay in the hot window, or it would decay
+        out, be evicted, and thrash back in."""
+        cache = getattr(self._dht, "hotcache", None)
+        if cache is None or not cache.active():
+            return entries
+        eligible = [e.cache_cb is not None for e in entries]
+        if not any(eligible):
+            return entries
+        served = cache.probe_wave([e.target for e in entries], eligible)
+        if not any(v is not None for v in served):
+            return entries
+        ks = getattr(self._dht, "keyspace", None)
+        if ks is not None:
+            ks.observe_hashes(
+                [e.target for e, v in zip(entries, served)
+                 if v is not None], source="cache")
+        remaining: List[_Entry] = []
+        for e, vals in zip(entries, served):
+            if vals is None:
+                remaining.append(e)
+                continue
+            try:
+                e.cache_cb(vals)
+            except Exception:
+                log.exception("cache-serve callback failed")
+        return remaining
 
     def _launch(self, af: int, k: int, entries: List[_Entry]) -> None:
         reg = telemetry.get_registry()

@@ -45,7 +45,8 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                require_ready: bool = False, op: str = "get",
                sample_max: int = 64, k: int = 8, mesh=None,
                window: float = 0.0,
-               max_imbalance: Optional[float] = None) -> tuple:
+               max_imbalance: Optional[float] = None,
+               min_cache_hit: Optional[float] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
     is the JSON-able cluster report and ``violations`` is a list of
     human-readable invariant failures (empty = healthy).
@@ -68,7 +69,13 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     ``max_imbalance`` gates the round-15 keyspace observatory's
     per-shard load balance: the worst node's ``dht_shard_imbalance``
     gauge (max/mean per-shard windowed traffic; -1 = unknown, never a
-    violation) must not exceed it."""
+    violation) must not exceed it.
+
+    ``min_cache_hit`` gates the round-16 hot-key serving cache: the
+    worst node's ``dht_cache_hit_ratio`` gauge (windowed hits /
+    eligible probes) must not drop below it — the SAME unknown
+    contract as ``max_imbalance``: a -1/absent gauge (cache disabled,
+    dark, or no probes in the window) never violates."""
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
@@ -131,6 +138,28 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                 % (worst, max_imbalance,
                    max(per_node, key=lambda p: p["imbalance"] or -1)
                    ["endpoint"]))
+    if min_cache_hit is not None and scrapes:
+        # per-node, worst = MIN: the gate is "every node's hot traffic
+        # is actually being served from its cache" — -1/absent =
+        # unknown (disabled / no probe window), never a violation
+        per_node = []
+        for s in scrapes:
+            vals = [v for name, v in s["series"].items()
+                    if name.startswith("dht_cache_hit_ratio") and v >= 0]
+            per_node.append({"endpoint": s["endpoint"],
+                             "hit_ratio": min(vals) if vals else None})
+        known = [p["hit_ratio"] for p in per_node
+                 if p["hit_ratio"] is not None]
+        worst = min(known) if known else None
+        doc["cache_hit"] = {"min": worst, "per_node": per_node}
+        if worst is not None and worst < min_cache_hit:
+            violations.append(
+                "cache hit ratio %.3f below %.3f (worst node %s)"
+                % (worst, min_cache_hit,
+                   min(per_node,
+                       key=lambda p: p["hit_ratio"]
+                       if p["hit_ratio"] is not None else 2.0)
+                   ["endpoint"]))
     if runners:
         cov = hm.replica_coverage(runners, sample_max=sample_max, k=k,
                                   mesh=mesh)
@@ -188,6 +217,14 @@ def main(argv=None) -> int:
                         "balance, the shard count is a single-shard "
                         "flood; unknown (no traffic window) never "
                         "violates")
+    p.add_argument("--min-cache-hit", type=float, default=None,
+                   metavar="R",
+                   help="fail when any node's hot-key cache hit ratio "
+                        "(dht_cache_hit_ratio: windowed hits / eligible "
+                        "probes from the round-16 serving cache) drops "
+                        "below R — unknown (-1/absent: cache disabled "
+                        "or no probe window) never violates, matching "
+                        "the --max-imbalance contract")
     p.add_argument("--json", action="store_true",
                    help="emit the full cluster report as one JSON doc")
     args = p.parse_args(argv)
@@ -204,7 +241,8 @@ def main(argv=None) -> int:
         violations, doc = run_checks(
             endpoints, alerts=alerts, min_success=args.min_success,
             require_ready=args.require_ready, op=args.op,
-            window=args.window, max_imbalance=args.max_imbalance)
+            window=args.window, max_imbalance=args.max_imbalance,
+            min_cache_hit=args.min_cache_hit)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
         return 2
@@ -226,6 +264,11 @@ def main(argv=None) -> int:
         if imb:
             print("shard imbalance: %s (worst node)" % (
                 "%.3f" % imb["max"] if imb["max"] is not None
+                else "unknown"))
+        ch = doc.get("cache_hit")
+        if ch:
+            print("cache hit ratio: %s (worst node)" % (
+                "%.3f" % ch["min"] if ch["min"] is not None
                 else "unknown"))
     for v in violations:
         print("ALERT:", v, file=sys.stderr)

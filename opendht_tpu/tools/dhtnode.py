@@ -49,6 +49,12 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            data the proxy serves on GET /keyspace;
                            'json' dumps the full snapshot (incl. the
                            256-bin histogram)
+    cache [json]           hot-key serving cache (round 16): occupancy,
+                           per-entry hit counts, windowed hit ratio,
+                           invalidation/eviction totals and the
+                           widened (closest-16) hot set — the same
+                           data the proxy serves on GET /cache; 'json'
+                           dumps the full snapshot
     dump [n] [name]        flight-recorder dump: last n (default 40)
                            structured events + span count (the
                            reference's dumpTables analogue); a
@@ -259,6 +265,37 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                             t_["estimate"], t_["share"] * 100))
                     if not snap["top"]:
                         print("  (no traffic observed yet)")
+            elif op == "cache":
+                # hot-key serving cache (ISSUE-11): same snapshot the
+                # proxy serves on GET /cache
+                import json as _json
+                snap = node.get_cache()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("hot-key cache disabled")
+                else:
+                    ratio = snap["hit_ratio"]
+                    print("occupancy %d/%d  hit ratio %s  hits %d  "
+                          "misses %d" % (
+                              snap["occupancy"], snap["capacity"],
+                              "%.3f" % ratio if ratio is not None
+                              else "unknown",
+                              snap["hits"], snap["misses"]))
+                    print("admissions %d  evictions %d  invalidations "
+                          "%d  replica k %d->%d on %d hot key(s)" % (
+                              snap["admissions"], snap["evictions"],
+                              snap["invalidations"],
+                              snap["replica_k"]["base"],
+                              snap["replica_k"]["widened"],
+                              len(snap["hot_keys"])))
+                    for ent in snap["entries"]:
+                        print("  %s  %d value(s)  %d hit(s)%s  ttl %.1fs"
+                              % (ent["key"], ent["values"], ent["hits"],
+                                 "  store-backed" if ent["store_backed"]
+                                 else "", ent["ttl_s"]))
+                    if not snap["entries"]:
+                        print("  (no hot keys cached yet)")
             elif op == "dump":
                 import json as _json
                 n, name = 40, None

@@ -64,8 +64,17 @@ def topology_snapshot(node) -> dict:
         "kernels": {},
         "health": {},
         "keyspace": {},
+        "cache": {},
         "events": [],
     }
+    try:
+        # round-16 hot-key serving cache: occupancy, hit ratio and the
+        # widened hot set, so a soak diff shows WHICH keys the acting
+        # layer served from cache (next to the keyspace section's
+        # detection of them)
+        snap["cache"] = node.get_cache()
+    except Exception:
+        pass
     try:
         # round-15 keyspace observatory: heavy hitters, occupied-bin
         # histogram and per-shard load attribution, so a soak diff

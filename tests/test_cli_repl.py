@@ -170,6 +170,17 @@ def test_repl_ingest_state(net, monkeypatch):
     assert re.search(r"time-in-queue p50 .* sheds \d+", out)
 
 
+def test_repl_cache_state(net, monkeypatch):
+    """The round-16 `cache` command surfaces the hot-value cache
+    (occupancy, hit ratio, replica-k) and the `json` form dumps the
+    full GET /cache snapshot."""
+    peer, node = net
+    out = repl(node, ["cache", "cache json", "x"], monkeypatch)
+    assert re.search(r"occupancy \d+/\d+  hit ratio", out)
+    assert re.search(r"replica k 8->16 on \d+ hot key\(s\)", out)
+    assert '"enabled": true' in out        # the json dump
+
+
 def test_repl_log_toggle(net, monkeypatch):
     peer, node = net
     out = repl(node, ["log", "log off", "x"], monkeypatch)

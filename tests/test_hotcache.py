@@ -1,0 +1,612 @@
+"""Hot-key serving cache + adaptive replication (ISSUE-11,
+opendht_tpu/hotcache.py + ops/cache_probe.py).
+
+Pins the tentpole's contracts: the batched XOR-compare probe kernel
+against its bit-exact host oracle (single-device AND the t-sharded
+twin), the admission/eviction/invalidation state machine keyed off the
+keyspace observatory tick, the serve-from-cache fast path (a hot get
+completes without the ``[Q]`` lookup launch; cache-on == cache-off
+values; batching-off takes the identical decision), put-then-get
+freshness, the replica widen/narrow decision vs a scalar oracle, the
+degrade-only health signal + dhtmon gate contracts, and kernels
+bit-identical with the cache active."""
+
+from __future__ import annotations
+
+import socket as _socket
+
+import numpy as np
+import pytest
+
+from opendht_tpu import telemetry
+from opendht_tpu.core.value import Value
+from opendht_tpu.hotcache import HotCacheConfig, HotValueCache
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.ops.cache_probe import cache_probe, probe_host
+from opendht_tpu.ops.ids import ids_from_hashes
+from opendht_tpu.runtime import Config, Dht
+from opendht_tpu.runtime.live_search import SEARCH_NODES, TARGET_NODES
+from opendht_tpu.scheduler import Scheduler
+from opendht_tpu.sockaddr import SockAddr
+
+AF = _socket.AF_INET
+
+
+# ------------------------------------------------------------ test helpers
+def make_dht(clock, n_nodes=12, **cfg_kw):
+    """A v4-only Dht on a virtual clock with a populated table and a
+    swallow-everything transport (the test_wave_builder harness)."""
+    cfg = Config(**cfg_kw)
+    dht = Dht(lambda data, addr: 0, config=cfg,
+              scheduler=Scheduler(clock=lambda: clock["t"]),
+              has_v6=False)
+    rng = np.random.default_rng(1234)
+    table = dht.tables[AF]
+    added = 0
+    while added < n_nodes:
+        h = InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+        if table.insert(h, SockAddr("10.9.0.%d" % (added + 1), 4500),
+                        now=clock["t"], confirm=2) is not None:
+            added += 1
+    return dht
+
+
+def warm(dht, key, observations=40):
+    """Drive the observatory hot rule for ``key`` and tick so the cache
+    admits it (needs a locally stored value to be store-backed)."""
+    for _ in range(observations):
+        dht.keyspace.observe_hashes([key])
+    dht.keyspace.tick()
+
+
+def top_entry(key, estimate=100, hot=True):
+    return {"key": bytes(key).hex(), "_key": bytes(key),
+            "estimate": estimate, "share": 0.5, "hot": hot}
+
+
+def fresh_registry(monkeypatch):
+    reg = telemetry.MetricsRegistry()
+    reg.enabled = True
+    monkeypatch.setattr(telemetry, "_registry", reg, raising=False)
+    monkeypatch.setattr(telemetry, "get_registry", lambda: reg)
+    return reg
+
+
+# ============================================================ probe kernel
+def test_probe_kernel_matches_host_oracle():
+    """Membership + slot from the device XOR-compare EQUAL the numpy
+    mirror over members, non-members, duplicates and invalid slots."""
+    rng = np.random.default_rng(7)
+    cache_ids = rng.integers(0, 2**32, (64, 5), dtype=np.uint32)
+    valid = np.ones(64, bool)
+    valid[50:] = False                      # tail slots invalid
+    targets = np.concatenate([
+        cache_ids[[3, 17, 3, 49]],          # members (one duplicated)
+        cache_ids[[55]],                    # id present but slot invalid
+        rng.integers(0, 2**32, (9, 5), dtype=np.uint32),   # misses
+    ])
+    dh, ds = cache_probe(cache_ids, valid, targets)
+    hh, hs = probe_host(cache_ids, valid, targets)
+    assert np.array_equal(np.asarray(dh), hh)
+    assert np.array_equal(np.asarray(ds), hs)
+    assert list(hh[:4]) == [True] * 4 and list(hs[:4]) == [3, 17, 3, 49]
+    assert not hh[4]                        # invalid slot never matches
+    assert not hh[5:].any()
+
+
+def test_probe_empty_and_single_target():
+    rng = np.random.default_rng(8)
+    cache_ids = np.zeros((16, 5), np.uint32)
+    valid = np.zeros(16, bool)
+    targets = rng.integers(0, 2**32, (5, 5), dtype=np.uint32)
+    dh, ds = cache_probe(cache_ids, valid, targets)
+    assert not np.asarray(dh).any() and (np.asarray(ds) == -1).all()
+    # an all-zero target against an all-zero INVALID table still misses
+    dh, _ = cache_probe(cache_ids, valid, np.zeros((1, 5), np.uint32))
+    assert not np.asarray(dh).any()
+
+
+def test_sharded_probe_twin_bit_identical():
+    """tp twin == single-device probe == host oracle, incl. ragged Q
+    (pad rows sliced off)."""
+    from opendht_tpu.parallel.sharded import (make_mesh,
+                                              sharded_cache_probe)
+    rng = np.random.default_rng(9)
+    cache_ids = rng.integers(0, 2**32, (32, 5), dtype=np.uint32)
+    valid = rng.random(32) < 0.8
+    mesh = make_mesh(4, q=1, t=4)
+    for q in (1, 5, 64):                    # ragged and aligned widths
+        targets = np.concatenate([
+            cache_ids[rng.integers(0, 32, max(1, q // 2))],
+            rng.integers(0, 2**32, (q - max(1, q // 2), 5),
+                         dtype=np.uint32),
+        ])[:q]
+        hh, hs = probe_host(cache_ids, valid, targets)
+        sh, ss = sharded_cache_probe(mesh, cache_ids, valid, targets)
+        assert np.array_equal(sh, hh) and np.array_equal(ss, hs), q
+
+
+# ===================================================== cache state machine
+def test_admission_eviction_and_window(monkeypatch):
+    fresh_registry(monkeypatch)
+    store = {}
+    now = {"t": 0.0}
+    hc = HotValueCache(HotCacheConfig(capacity=4, entry_ttl=10.0),
+                       local_values=lambda kb: store.get(kb, []),
+                       clock=lambda: now["t"])
+    k1, k2 = InfoHash.get("hc-a"), InfoHash.get("hc-b")
+    store[bytes(k1)] = [Value(b"a", value_id=1)]
+    # k1 has local values -> admitted; k2 has none -> hot but uncached
+    hc.on_keyspace_tick([top_entry(k1), top_entry(k2)])
+    snap = hc.snapshot()
+    assert snap["occupancy"] == 1
+    assert [e["key"] for e in snap["entries"]] == [bytes(k1).hex()]
+    assert all(e["store_backed"] for e in snap["entries"])
+    assert hc.is_hot(k1) and hc.is_hot(k2)
+    assert hc.wants(k2) and not hc.wants(k1)
+    # serving k1 hits; k2 (uncached) misses
+    assert [v.data for v in hc.serve_one(k1)] == [b"a"]
+    assert hc.serve_one(k2) is None
+    # window rolls on the next tick: 1 hit / 2 probes
+    hc.on_keyspace_tick([top_entry(k1), top_entry(k2)])
+    assert hc.hit_ratio() == 0.5
+    # decay: k1 drops out of the hot set -> evicted, narrow
+    hc.on_keyspace_tick([])
+    assert hc.snapshot()["occupancy"] == 0
+    assert not hc.is_hot(k1)
+    assert hc.hit_ratio() is None           # empty window = unknown
+
+
+def test_offer_fill_on_get_and_ttl_expiry(monkeypatch):
+    fresh_registry(monkeypatch)
+    now = {"t": 0.0}
+    hc = HotValueCache(HotCacheConfig(entry_ttl=5.0),
+                       local_values=lambda kb: [],
+                       clock=lambda: now["t"])
+    k = InfoHash.get("hc-offer")
+    assert not hc.offer(k, [Value(b"x", value_id=1)])   # not hot yet
+    hc.on_keyspace_tick([top_entry(k)])
+    assert hc.wants(k)
+    assert hc.offer(k, [Value(b"x", value_id=1)])
+    assert not hc.offer(k, [Value(b"y", value_id=2)])   # already cached
+    assert [v.id for v in hc.serve_one(k)] == [1]
+    # no store backing: the entry expires after entry_ttl on a tick
+    now["t"] = 6.0
+    hc.on_keyspace_tick([top_entry(k)])
+    assert hc.snapshot()["occupancy"] == 0
+
+
+def test_capacity_bound_keeps_hottest(monkeypatch):
+    fresh_registry(monkeypatch)
+    store = {}
+    hc = HotValueCache(HotCacheConfig(capacity=2),
+                       local_values=lambda kb: store.get(kb, []),
+                       clock=lambda: 0.0)
+    keys = [InfoHash.get("hc-cap-%d" % i) for i in range(4)]
+    for k in keys:
+        store[bytes(k)] = [Value(b"v", value_id=1)]
+    # estimate order: keys[0] hottest
+    hc.on_keyspace_tick([top_entry(k, estimate=100 - i)
+                         for i, k in enumerate(keys)])
+    snap = hc.snapshot()
+    assert snap["occupancy"] == 2
+    kept = set(e["key"] for e in snap["entries"])
+    assert kept == {bytes(keys[0]).hex(), bytes(keys[1]).hex()}
+
+
+def test_invalidate_drops_entry_and_counts(monkeypatch):
+    fresh_registry(monkeypatch)
+    store = {}
+    hc = HotValueCache(HotCacheConfig(),
+                       local_values=lambda kb: store.get(kb, []),
+                       clock=lambda: 0.0)
+    k = InfoHash.get("hc-inv")
+    store[bytes(k)] = [Value(b"v", value_id=1)]
+    hc.on_keyspace_tick([top_entry(k)])
+    assert hc.snapshot()["occupancy"] == 1
+    assert hc.invalidate(k)
+    assert not hc.invalidate(k)             # idempotent
+    snap = hc.snapshot()
+    assert snap["occupancy"] == 0 and snap["invalidations"] == 1
+    # the key is STILL hot: the next tick re-admits from the store
+    hc.on_keyspace_tick([top_entry(k)])
+    assert hc.snapshot()["occupancy"] == 1
+
+
+def test_probe_wave_counts_only_eligible(monkeypatch):
+    fresh_registry(monkeypatch)
+    store = {}
+    hc = HotValueCache(HotCacheConfig(),
+                       local_values=lambda kb: store.get(kb, []),
+                       clock=lambda: 0.0)
+    k_hot, k_cold = InfoHash.get("hc-el-a"), InfoHash.get("hc-el-b")
+    store[bytes(k_hot)] = [Value(b"v", value_id=1)]
+    hc.on_keyspace_tick([top_entry(k_hot)])
+    served = hc.probe_wave([k_hot, k_cold, k_hot], [True, True, False])
+    assert served[0] is not None and [v.id for v in served[0]] == [1]
+    assert served[1] is None
+    assert served[2] is None                # hit, but INELIGIBLE: not served
+    snap = hc.snapshot()
+    assert snap["hits"] == 1 and snap["misses"] == 1
+
+
+def test_probe_go_dark_on_device_failure(monkeypatch):
+    fresh_registry(monkeypatch)
+    store = {}
+    hc = HotValueCache(HotCacheConfig(),
+                       local_values=lambda kb: store.get(kb, []),
+                       clock=lambda: 0.0)
+    k = InfoHash.get("hc-dark")
+    store[bytes(k)] = [Value(b"v", value_id=1)]
+    hc.on_keyspace_tick([top_entry(k)])
+    import opendht_tpu.ops.cache_probe as cp
+
+    def boom(*a, **kw):
+        raise RuntimeError("device gone")
+    monkeypatch.setattr(cp, "cache_probe", boom)
+    served = hc.probe_wave([k], [True])
+    assert served == [None]                 # wave proceeds unchanged
+    assert not hc.enabled and not hc.active()
+    assert hc.snapshot() == {"enabled": False} or \
+        hc.snapshot().get("enabled") is False
+    assert hc.hit_ratio() is None and hc.serve_one(k) is None
+    assert hc.replica_k(k) == hc.cfg.base_k  # dark cache never widens
+
+
+def test_disabled_cache_registers_no_series(monkeypatch):
+    reg = fresh_registry(monkeypatch)
+    HotValueCache(HotCacheConfig(enabled=False), clock=lambda: 0.0)
+    assert not any(k.startswith("dht_cache") for k in
+                   reg.snapshot()["gauges"])
+
+
+# ======================================================== Dht integration
+def spy_batched(dht):
+    calls = []
+    orig = dht.find_closest_nodes_batched
+
+    def wrapper(targets, af, count=8):
+        calls.append((len(targets), af, count))
+        return orig(targets, af, count)
+
+    dht.find_closest_nodes_batched = wrapper
+    return calls
+
+
+def warmed_dht(clock, **cfg_kw):
+    """Dht with a locally-stored hot key admitted into the cache."""
+    dht = make_dht(clock, **cfg_kw)
+    hot = InfoHash.get("hot-int")
+    assert dht.storage_store(hot, Value(b"hv", value_id=7), clock["t"])
+    warm(dht, hot)
+    assert dht.hotcache.snapshot()["occupancy"] == 1
+    return dht, hot
+
+
+def test_cache_served_get_skips_lookup_launch():
+    clock = {"t": 1000.0}
+    dht, hot = warmed_dht(clock, ingest_fill_target=64,
+                          ingest_deadline=0.002)
+    calls = spy_batched(dht)
+    got, done = [], []
+    dht.get(hot, get_cb=lambda vals: got.extend(vals) or True,
+            done_cb=lambda ok, ns: done.append(ok))
+    dht.scheduler.run()
+    clock["t"] += 0.0025
+    dht.scheduler.run()                     # deadline wave: probe serves
+    assert done == [True]
+    assert [v.data for v in got] == [b"hv"]
+    assert calls == [], "hot get still joined a lookup launch: %r" % calls
+    snap = dht.hotcache.snapshot()
+    assert snap["hits"] == 1 and snap["misses"] == 0
+    # the search completed and is reusable
+    sr = dht.searches[AF][hot]
+    assert sr.done and not sr.callbacks
+
+
+def test_cache_on_off_values_equivalent():
+    """The value set a cache-served get delivers equals what the
+    cache-off node delivers for the same key/table (the live-cluster
+    halves of this pin run in testing/cache_smoke.py)."""
+    def run(enabled: bool):
+        clock = {"t": 2000.0}
+        cfg = {}
+        dht = make_dht(clock)
+        dht.config.cache.enabled = enabled
+        if not enabled:
+            dht.hotcache.cfg.enabled = False
+        hot = InfoHash.get("hot-eq")
+        assert dht.storage_store(hot, Value(b"ev", value_id=3),
+                                 clock["t"])
+        warm(dht, hot)
+        got = []
+        dht.get(hot, get_cb=lambda vals: got.extend(vals) or True)
+        dht.scheduler.run()
+        clock["t"] += 0.0025
+        dht.scheduler.run()
+        return set((v.id, bytes(v.data)) for v in got)
+
+    assert run(True) == run(False) == {(3, b"ev")}
+
+
+def test_announce_and_listen_never_cache_served():
+    clock = {"t": 3000.0}
+    dht, hot = warmed_dht(clock)
+    calls = spy_batched(dht)
+    # a put's search carries an announce: NOT eligible — the refill
+    # must resolve real nodes
+    dht.put(hot, Value(b"nv", value_id=9))
+    dht.scheduler.run()
+    clock["t"] += 0.0025
+    dht.scheduler.run()
+    assert any(c[2] == SEARCH_NODES for c in calls), \
+        "announce refill never launched"
+    sr = dht.searches[AF][hot]
+    assert sr.announce and not dht._cache_eligible(sr)
+    # a listen search is not eligible either
+    calls.clear()
+    key2 = InfoHash.get("hot-int-2")
+    dht.listen(key2, lambda vals, exp: True)
+    sr2 = dht.searches[AF][key2]
+    assert sr2.listeners and not dht._cache_eligible(sr2)
+
+
+def test_put_invalidates_cached_entry():
+    clock = {"t": 4000.0}
+    dht, hot = warmed_dht(clock)
+    assert dht.hotcache.snapshot()["occupancy"] == 1
+    dht.put(hot, Value(b"v2", value_id=8))
+    snap = dht.hotcache.snapshot()
+    assert snap["occupancy"] == 0 and snap["invalidations"] >= 1
+    # the local store now has BOTH values; the next get delivers them
+    # (full path — no stale single-value cache hit)
+    got = []
+    dht.get(hot, get_cb=lambda vals: got.extend(vals) or True)
+    assert set(v.id for v in got) == {7, 8}
+
+
+def test_batching_off_serve_one_same_decision():
+    clock = {"t": 5000.0}
+    dht, hot = warmed_dht(clock, ingest_batching="off")
+    assert not dht.wave_builder.enabled
+    calls = spy_batched(dht)
+    got, done = [], []
+    dht.get(hot, get_cb=lambda vals: got.extend(vals) or True,
+            done_cb=lambda ok, ns: done.append(ok))
+    assert done == [True] and [v.data for v in got] == [b"hv"]
+    assert calls == []                      # no per-op launch either
+    # the host-dict decision == the device probe's (same source of
+    # truth; the probe kernel itself is pinned vs probe_host above)
+    hc = dht.hotcache
+    with hc._lock:
+        if hc._dirty or hc._ids_dev is None:
+            hc._rebuild_device_locked()
+    hh, _ = probe_host(np.asarray(hc._ids_dev), np.asarray(hc._valid_dev),
+                       ids_from_hashes([hot]))
+    assert bool(hh[0]) == (hc.serve_one(hot) is not None)
+
+
+def test_wave_results_bit_identical_with_cache_active():
+    clock = {"t": 6000.0}
+    dht, hot = warmed_dht(clock, n_nodes=24)
+    targets = [InfoHash.get("bit-%d" % i) for i in range(16)]
+    base = dht.find_closest_nodes_batched(targets, AF, SEARCH_NODES)
+    dht.hotcache.probe_wave(targets + [hot], [True] * 17)
+    after = dht.find_closest_nodes_batched(targets, AF, SEARCH_NODES)
+    assert [[n.id for n in row] for row in base] == \
+        [[n.id for n in row] for row in after]
+
+
+def test_offer_token_rejects_mid_get_invalidation(monkeypatch):
+    """Review finding: a get in flight across a put must not re-seed
+    the stale pre-put values — invalidate bumps the key's freshness
+    token even when nothing is cached, and an offer carrying the older
+    token is rejected."""
+    fresh_registry(monkeypatch)
+    hc = HotValueCache(HotCacheConfig(), local_values=lambda kb: [],
+                       clock=lambda: 0.0)
+    k = InfoHash.get("hc-token")
+    hc.on_keyspace_tick([top_entry(k)])
+    tok = hc.offer_token(k)
+    assert hc.invalidate(k) is False        # uncached — but the seq bumps
+    assert not hc.offer(k, [Value(b"stale", value_id=1)], token=tok)
+    assert hc.snapshot()["occupancy"] == 0
+    # a fresh token (captured after the put) is accepted
+    assert hc.offer(k, [Value(b"fresh", value_id=2)],
+                    token=hc.offer_token(k))
+    assert [v.id for v in hc.serve_one(k)] == [2]
+
+
+def test_listen_joining_queued_refill_not_swallowed():
+    """Review finding: eligibility decided at submit must be RE-CHECKED
+    at serve time — a listen joining the search while its refill sits
+    in the wave queue would otherwise have the refill swallowed by a
+    cache hit, leaving the search with zero candidates."""
+    clock = {"t": 12000.0}
+    dht, hot = warmed_dht(clock, ingest_fill_target=64,
+                          ingest_deadline=0.002)
+    dht.get(hot, get_cb=lambda vals: True)
+    sr = dht.searches[AF][hot]
+    assert sr.refill_pending
+    dht.listen(hot, lambda vals, exp: True)     # joins the SAME search
+    assert sr.listeners and not dht._cache_eligible(sr)
+    for _ in range(3):                          # fire + re-ridden refill
+        clock["t"] += 0.0025
+        dht.scheduler.run()
+    assert len(sr.nodes) > 0, \
+        "queued refill was swallowed by the cache hit"
+    assert not sr.expired and sr.listeners
+
+
+def test_quiet_observatory_ticks_still_roll_cache_window():
+    """Review finding: a fully-idle observatory tick (nothing observed,
+    window decayed to zero) must still notify subscribers, or the
+    cache's windowed hit ratio freezes at its last value and the
+    degrade-only health signal never clears."""
+    clock = {"t": 13000.0}
+    dht, hot = warmed_dht(clock)
+    # a miss-heavy window
+    dht.hotcache.serve_one(InfoHash.get("q-miss-1"))
+    dht.hotcache.serve_one(InfoHash.get("q-miss-2"))
+    dht.keyspace.tick()                         # rolls: ratio 0.0
+    assert dht.hotcache.hit_ratio() == 0.0
+    # decay the window to quiet, then tick with NOTHING observed — the
+    # not-dirty path must still notify, rolling the ratio to unknown
+    for _ in range(40):
+        dht.keyspace.tick()
+    # the live accumulator decayed to quiet (the published
+    # window_total retains the last SCORED window by design)
+    assert dht.keyspace._window_total == 0
+    assert dht.hotcache.hit_ratio() is None, \
+        "idle ticks froze the hit-ratio window"
+
+
+# ======================================================== replica widening
+def test_replica_k_widens_and_narrows_vs_scalar_oracle():
+    clock = {"t": 7000.0}
+    dht, hot = warmed_dht(clock)
+    cold = InfoHash.get("cold-rk")
+    # scalar oracle: k = 16 iff the key is in the observatory hot set
+    hot_set = set(dht.keyspace.snapshot()["hot_keys"])
+
+    def oracle(key):
+        return 16 if bytes(key).hex() in hot_set else 8
+
+    assert dht._replica_k(hot) == oracle(hot) == 16
+    assert dht._replica_k(cold) == oracle(cold) == 8
+    # narrow on decay: an empty tick clears the hot set
+    dht.hotcache.on_keyspace_tick([])
+    assert dht._replica_k(hot) == 8
+
+
+def test_republish_predicate_widened_matches_scalar_oracle():
+    """The ONE widened resolve (max(ks)) gives EVERY key the same
+    decision as a per-key scalar resolve at its own k — the top-k
+    prefix property, pinned over mixed 8/16 replica sets."""
+    clock = {"t": 8000.0}
+    dht = make_dht(clock, n_nodes=40)
+    keys = [InfoHash.get("rp-%d" % i) for i in range(12)]
+    ks = [16 if i % 3 == 0 else 8 for i in range(12)]
+    got = dht._republish_predicate(keys, AF, ks)
+    for key, k_i, decision in zip(keys, ks, got):
+        nodes = dht.find_closest_nodes(key, AF, k_i)
+        want = bool(nodes) and key.xor_cmp(nodes[-1].id, dht.myid) < 0
+        assert decision == want, (key, k_i)
+    # uniform base-k ks is bit-identical to the legacy no-ks call
+    assert dht._republish_predicate(keys, AF) == \
+        dht._republish_predicate(keys, AF, [TARGET_NODES] * len(keys))
+
+
+def test_announce_walk_capacity_widens_and_narrows():
+    clock = {"t": 9000.0}
+    dht, hot = warmed_dht(clock)
+    dht.put(hot, Value(b"w", value_id=5))
+    sr = dht.searches[AF][hot]
+    dht._search_send_announce(sr)
+    assert sr.capacity == 16 + (SEARCH_NODES - TARGET_NODES)
+    # decay -> narrow back on the next announce pass
+    dht.hotcache.on_keyspace_tick([])
+    dht._search_send_announce(sr)
+    assert sr.capacity == SEARCH_NODES
+
+
+def test_storage_maintenance_counts_widened_keys(monkeypatch):
+    reg = fresh_registry(monkeypatch)
+    clock = {"t": 10000.0}
+    dht = make_dht(clock, maintain_storage=True)
+    hot = InfoHash.get("maint-hot")
+    dht.storage_store(hot, Value(b"m", value_id=2), clock["t"])
+    warm(dht, hot)
+    st = dht.store[hot]
+    st.maintenance_time = clock["t"]        # force due NOW
+    dht._storage_maintenance_batched([hot])
+    assert reg.counter("dht_cache_republish_widened_total").value == 1
+
+
+# ===================================================== surfaces and gates
+def test_health_signal_is_miss_fraction_and_degrade_only():
+    from opendht_tpu.health import (DEFAULT_SIGNAL_THRESHOLDS,
+                                    HealthConfig, NodeHealth)
+    assert "cache_hit_ratio" in DEFAULT_SIGNAL_THRESHOLDS
+    assert "cache_hit_ratio" in HealthConfig().degrade_only
+    clock = {"t": 11000.0}
+    dht, hot = warmed_dht(clock)
+    nh = NodeHealth(dht)
+    assert nh.evaluator.providers["cache_hit_ratio"]() is None  # no window
+    dht.hotcache.serve_one(hot)             # 1 hit
+    dht.hotcache.serve_one(InfoHash.get("miss-h"))   # 1 miss
+    dht.hotcache.on_keyspace_tick(
+        [top_entry(hot)])                   # roll the window
+    assert nh.evaluator.providers["cache_hit_ratio"]() == \
+        pytest.approx(0.5)                  # miss fraction = 1 - ratio
+
+
+def test_dhtmon_min_cache_hit_contract(monkeypatch):
+    """-1/absent never violates (matching --max-imbalance); a known
+    ratio below the gate does, and the worst (min) node decides."""
+    from opendht_tpu.tools import dhtmon
+
+    def fake_scrapes(series_list):
+        it = iter(series_list)
+
+        def scrape(ep, timeout=10.0):
+            return {"endpoint": ep, "ready": True, "verdict": "healthy",
+                    "health": {}, "series": next(it)}
+        return scrape
+
+    eps = ["a:1", "b:2"]
+    # absent + unknown(-1): no violation
+    monkeypatch.setattr(dhtmon.hm, "scrape_node", fake_scrapes(
+        [{}, {'dht_cache_hit_ratio{node="n"}': -1.0}]))
+    viol, doc = dhtmon.run_checks(eps, min_cache_hit=0.9)
+    assert viol == [] and doc["cache_hit"]["min"] is None
+    # worst node below the gate: violation names it
+    monkeypatch.setattr(dhtmon.hm, "scrape_node", fake_scrapes(
+        [{'dht_cache_hit_ratio{node="n"}': 0.95},
+         {'dht_cache_hit_ratio{node="n"}': 0.4}]))
+    viol, doc = dhtmon.run_checks(eps, min_cache_hit=0.9)
+    assert len(viol) == 1 and "b:2" in viol[0]
+    assert doc["cache_hit"]["min"] == pytest.approx(0.4)
+    # both above: green
+    monkeypatch.setattr(dhtmon.hm, "scrape_node", fake_scrapes(
+        [{'dht_cache_hit_ratio{node="n"}': 0.95},
+         {'dht_cache_hit_ratio{node="n"}': 0.92}]))
+    viol, _doc = dhtmon.run_checks(eps, min_cache_hit=0.9)
+    assert viol == []
+
+
+def test_scanner_snapshot_has_cache_section():
+    from opendht_tpu.tools.dhtscanner import topology_snapshot
+
+    class FakeRunner:
+        def get_node_id(self):
+            return InfoHash.get("scan-cache")
+
+        def get_bound_port(self):
+            return 0
+
+        def get_cache(self):
+            return {"enabled": True, "occupancy": 1}
+
+        def get_keyspace(self):
+            return {"enabled": False}
+
+        def get_health(self):
+            return {"verdict": "unknown"}
+
+        def get_metrics(self):
+            return {}
+
+        def get_node_stats(self, af):
+            raise OSError
+
+        def get_flight_recorder(self, limit=None):
+            return {"events": []}
+
+    snap = topology_snapshot(FakeRunner())
+    assert snap["cache"] == {"enabled": True, "occupancy": 1}
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,230 @@
+"""core/op_cache.py coverage (ISSUE-11 satellite).
+
+The listen-operation dedup caches (reference src/op_cache.{h,cpp}) were
+an untested thin host port.  Pins: OpValueCache's cross-subscription
+ref-counting and the cache_callback collapse wrapper, OpCache's
+replay-on-attach / one-shot unsubscribe / 60 s listener-less linger
+(inclusive expiry boundary — the virtual-clock live-lock fix), and
+SearchCache's query-keyed op sharing, cancellation bookkeeping and
+expiry sweep."""
+
+from __future__ import annotations
+
+import pytest
+
+from opendht_tpu.core.op_cache import (OP_LINGER, OpCache, OpValueCache,
+                                       SearchCache)
+from opendht_tpu.core.value import Query, Select, Value, Where
+from opendht_tpu.utils import TIME_MAX
+
+
+def v(vid: int) -> Value:
+    return Value(b"d%d" % vid, value_id=vid)
+
+
+def sink():
+    events = []
+
+    def cb(vals, expired):
+        events.append((sorted(x.id for x in vals), expired))
+        return True
+    return events, cb
+
+
+# ------------------------------------------------------------ OpValueCache
+def test_refcount_expires_only_when_all_sources_expire():
+    events, cb = sink()
+    ovc = OpValueCache(cb)
+    # two network ops announce the same value: one add event, ref 2
+    assert ovc.on_values_added([v(1)])
+    assert ovc.on_values_added([v(1)])
+    assert events == [([1], False)]
+    # first expiry only decrements; the second releases it
+    assert ovc.on_values_expired([v(1)])
+    assert events == [([1], False)]
+    assert ovc.get_by_id(1) is not None
+    assert ovc.on_values_expired([v(1)])
+    assert events == [([1], False), ([1], True)]
+    assert ovc.get_by_id(1) is None and ovc.get_values() == []
+
+
+def test_expire_of_unknown_value_is_noop():
+    events, cb = sink()
+    ovc = OpValueCache(cb)
+    assert ovc.on_values_expired([v(9)])
+    assert events == []
+
+
+def test_false_return_unsubscribes_none_stays():
+    returns = iter([None, False])
+    ovc = OpValueCache(lambda vals, exp: next(returns))
+    # None keeps the subscription (LocalListener.notify contract)...
+    assert ovc.on_values_added([v(1)]) is True
+    # ...only an explicit False unsubscribes
+    assert ovc.on_values_added([v(2)]) is False
+
+
+def test_cache_callback_collapses_duplicate_adds():
+    events, cb = sink()
+    wrapped = OpValueCache.cache_callback(cb)
+    wrapped([v(1)], False)
+    wrapped([v(1)], False)          # duplicate add: ref-counted, no event
+    wrapped([v(1)], True)           # first expire: ref drops to 1
+    assert events == [([1], False)]
+    wrapped([v(1)], True)           # second expire releases
+    assert events == [([1], False), ([1], True)]
+
+
+# ----------------------------------------------------------------- OpCache
+def test_add_listener_replays_cache_state():
+    op = OpCache(now=0.0)
+    op.on_value([v(1), v(2)], False)
+    events, cb = sink()
+    op.add_listener(7, cb, Query(), None, now=1.0)
+    assert events == [([1, 2], False)]       # replay on attach
+    op.on_value([v(3)], False)
+    assert events[-1] == ([3], False)
+    assert op.get_expiration() == TIME_MAX   # has listeners: never expires
+
+
+def test_one_shot_listener_satisfied_from_cache_detaches():
+    op = OpCache(now=0.0)
+    op.on_value([v(1)], False)
+    # a listener returning False is satisfied by the replay and must
+    # not stay registered (op_cache.h:87-90)
+    op.add_listener(7, lambda vals, exp: False, Query(), None, now=2.0)
+    assert op.is_done()
+    # linger clock anchored at the removal
+    assert op.get_expiration() == 2.0 + OP_LINGER
+
+
+def test_empty_cache_replay_fires_nothing_and_keeps_listener():
+    events, cb = sink()
+    op = OpCache(now=0.0)
+    op.add_listener(7, cb, Query(), None, now=0.0)
+    assert events == [] and not op.is_done()
+
+
+def test_linger_window_and_inclusive_expiry_boundary():
+    events, cb = sink()
+    op = OpCache(now=0.0)
+    op.add_listener(1, cb, Query(), None, now=0.0)
+    assert not op.is_expired(1e9)            # listeners pin it alive
+    assert op.remove_listener(1, now=100.0)
+    assert not op.remove_listener(1, now=100.0)   # already gone
+    assert op.is_done()
+    assert not op.is_expired(100.0 + OP_LINGER - 0.001)
+    # INCLUSIVE boundary: exp == now IS expired (strict '<' live-locked
+    # a virtual clock that only advances between events)
+    assert op.is_expired(100.0 + OP_LINGER)
+
+
+def test_dispatch_unsubscribes_returning_false_mid_feed():
+    op = OpCache(now=0.0, clock=lambda: 42.0)
+    seen = []
+    op.add_listener(1, lambda vals, exp: (seen.append(1), False)[-1],
+                    Query(), None, now=0.0)
+    op.on_value([v(1)], False)               # listener consumed + left
+    assert seen == [1] and op.is_done()
+    assert op.get_expiration() == 42.0 + OP_LINGER   # dispatch clock
+
+
+# ------------------------------------------------------------- SearchCache
+def test_listen_shares_one_network_op_per_query():
+    sc = SearchCache()
+    started = []
+
+    def on_listen(q, vcb):
+        started.append(q)
+        return 100 + len(started)
+
+    e1, cb1 = sink()
+    e2, cb2 = sink()
+    t1 = sc.listen(cb1, Query(), None, on_listen, now=0.0)
+    t2 = sc.listen(cb2, Query(), None, on_listen, now=0.0)
+    assert len(started) == 1                 # identical query: shared op
+    assert t1 != t2 and len(sc) == 1
+    assert sc.cancel_listen(t1, now=1.0)
+    assert not sc.cancel_listen(t1, now=1.0)     # idempotent
+    assert sc.cancel_listen(t2, now=2.0)
+    # both listeners gone: the shared op lingers from the LAST removal
+    assert sc.get_expiration() == 2.0 + OP_LINGER
+
+
+def test_listen_routes_to_op_whose_query_satisfies():
+    sc = SearchCache()
+    started = []
+
+    def on_listen(q, vcb):
+        started.append(q)
+        return len(started)
+
+    wide = Query()                           # selects everything
+    narrow = Query(Select(), Where().id(7))
+    sc.listen(lambda *_: True, wide, None, on_listen, now=0.0)
+    # the narrow query is satisfied by the wide op: no second network op
+    sc.listen(lambda *_: True, narrow, None, on_listen, now=0.0)
+    assert len(started) == 1
+    # the REVERSE does not hold: a wide listen after a narrow one needs
+    # its own op
+    sc2 = SearchCache()
+    started.clear()
+    sc2.listen(lambda *_: True, narrow, None, on_listen, now=0.0)
+    sc2.listen(lambda *_: True, wide, None, on_listen, now=0.0)
+    assert len(started) == 2
+
+
+def test_expire_drops_lingered_ops_and_cancels_tokens():
+    sc = SearchCache()
+    sc_tokens = []
+
+    def on_listen(q, vcb):
+        return 42
+
+    t = sc.listen(lambda *_: True, Query(), None, on_listen, now=0.0)
+    sc.cancel_listen(t, now=0.0)
+    # before the linger elapses nothing expires
+    nxt = sc.expire(OP_LINGER - 1.0, sc_tokens.append)
+    assert sc_tokens == [] and len(sc) == 1 and nxt == OP_LINGER
+    # at the boundary (inclusive) the op drops and its token cancels
+    nxt = sc.expire(OP_LINGER, sc_tokens.append)
+    assert sc_tokens == [42] and len(sc) == 0 and nxt == TIME_MAX
+
+
+def test_cancel_all_tears_down_every_op():
+    sc = SearchCache()
+    cancelled = []
+    # two DISJOINT narrow queries: neither satisfies the other, so each
+    # starts its own network op
+    sc.listen(lambda *_: True, Query(Select(), Where().id(3)), None,
+              lambda q, cb: 1, now=0.0)
+    sc.listen(lambda *_: True, Query(Select(), Where().id(4)), None,
+              lambda q, cb: 2, now=0.0)
+    assert len(sc) == 2
+    sc.cancel_all(cancelled.append)
+    assert sorted(cancelled) == [1, 2] and len(sc) == 0
+
+
+def test_get_deduplicates_across_ops():
+    sc = SearchCache()
+    feeds = {}
+
+    def on_listen(q, vcb):
+        feeds[len(feeds) + 1] = vcb
+        return len(feeds)
+
+    sc.listen(lambda *_: True, Query(Select(), Where().id(1)), None,
+              on_listen, now=0.0)
+    sc.listen(lambda *_: True, Query(Select(), Where().id(2)), None,
+              on_listen, now=0.0)
+    feeds[1]([v(1), v(5)], False)
+    feeds[2]([v(2), v(5)], False)            # value 5 seen by both ops
+    got = sorted(x.id for x in sc.get())
+    assert got == [1, 2, 5]
+    assert sc.get_by_id(5) is not None
+    assert sc.get_by_id(99) is None
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

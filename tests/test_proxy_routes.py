@@ -95,6 +95,28 @@ def test_trace_route_malformed_vs_unknown_id(topology):
     assert code == 200 and doc["traceEvents"] == []
 
 
+def test_cache_endpoint(topology):
+    """GET /cache (ISSUE-11): the hot-value cache snapshot as JSON —
+    a key admitted through the observe→act loop shows up with its hit
+    bookkeeping; the route never 500s on an empty cache."""
+    peer, proxy_node, server = topology
+    code, doc = _get(server, "/cache")
+    assert code == 200 and doc["enabled"] is True
+    assert doc["occupancy"] == len(doc["entries"])
+    key = InfoHash.get("proxy-cache-key")
+    assert proxy_node.put_sync(key, Value(b"cv", value_id=91),
+                               timeout=20.0)
+    ks = proxy_node._dht.keyspace
+    for _ in range(max(40, ks.cfg.hot_min_count + 8)):
+        ks.observe_hashes([key])
+    ks.tick()                      # admits through the subscriber hook
+    code, doc = _get(server, "/cache")
+    assert code == 200
+    assert key.hex() in [e["key"] for e in doc["entries"]], doc
+    assert key.hex() in doc["hot_keys"]
+    assert doc["replica_k"] == {"base": 8, "widened": 16}
+
+
 def test_keyspace_endpoint(topology):
     """GET /keyspace (ISSUE-10): the observatory snapshot as JSON —
     traffic driven through the proxy node surfaces in the histogram

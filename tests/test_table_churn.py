@@ -384,6 +384,11 @@ def test_replay_overflow_counts_one_compaction():
     for h in late:
         t.insert(h, None, now=4.0, confirm=2)
     assert sum(op == "i" for op, _ in t._pending_base["mutlog"]) > 4
+    # view() only installs a FINISHED compaction (_maybe_swap checks
+    # is_ready without force) — block on the async dispatch first, or
+    # a loaded CI host intermittently reaches view() before the
+    # background result lands and the swap assertions below flake
+    t._pending_base["n_valid"].block_until_ready()
     v = t.view(5.0)                         # swap + overflowing replay
     assert t._pending_base is None
     assert t.compactions == c0 + 1, \

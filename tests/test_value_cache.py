@@ -1,0 +1,170 @@
+"""core/value_cache.py coverage (ISSUE-11 satellite).
+
+The listen-side per-(node, query) value cache (reference
+src/value_cache.h) was an untested thin host port while it became one
+of the building blocks the round-16 hot-key serving layer sits next
+to.  Pins the contracts hotcache/live_search rely on: add/refresh/
+expire event dispatch through the one callback, the refreshed/expired
+id lists from value-update packets, next-expiration scheduling, the
+standalone expiry sweep, clear(), and the MAX_VALUES oldest-evicted
+cap."""
+
+from __future__ import annotations
+
+import pytest
+
+from opendht_tpu.core.value import TypeStore, Value, ValueType
+from opendht_tpu.core.value_cache import MAX_VALUES, ValueCache
+from opendht_tpu.utils import TIME_MAX
+
+
+def collector():
+    events = []
+    return events, lambda vals, expired: events.append(
+        (sorted(v.id for v in vals), expired))
+
+
+def types_with(expiration: float) -> TypeStore:
+    ts = TypeStore()
+    ts.register_type(ValueType(0, "t", expiration))
+    return ts
+
+
+def v(vid: int) -> Value:
+    return Value(b"d%d" % vid, value_id=vid)
+
+
+def test_add_then_expire_dispatches_through_callback():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(10.0)
+    nxt = vc.on_values([v(1), v(2)], (), (), ts, now=100.0)
+    assert events == [([1, 2], False)]
+    assert nxt == 110.0                      # next expiration scheduled
+    assert sorted(x.id for x in vc.get_values()) == [1, 2]
+    # sweep at the expiration: both expire, cache empties, TIME_MAX
+    events.clear()
+    nxt = vc.expire_values(now=110.0)
+    assert events == [([1, 2], True)]
+    assert nxt == TIME_MAX and len(vc) == 0
+
+
+def test_readd_refreshes_instead_of_duplicating():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(10.0)
+    vc.on_values([v(1)], (), (), ts, now=0.0)
+    events.clear()
+    # same id again: refreshed (no add event), expiration extended
+    nxt = vc.on_values([v(1)], (), (), ts, now=5.0)
+    assert events == [] and nxt == 15.0
+    assert vc.expire_values(now=10.0) == 15.0   # survived the old slot
+    assert len(vc) == 1
+
+
+def test_refreshed_id_list_extends_expiration():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(10.0)
+    vc.on_values([v(1), v(2)], (), (), ts, now=0.0)
+    events.clear()
+    # peer refreshed id 1 only; id 2 keeps its original deadline
+    nxt = vc.on_values((), [1], (), ts, now=8.0)
+    assert nxt == 10.0                       # id 2 is next
+    assert events == []
+    events.clear()
+    nxt = vc.expire_values(now=10.0)
+    assert events == [([2], True)]
+    assert nxt == 18.0                       # refreshed id 1 remains
+    # refreshing an unknown id is a silent no-op (value_cache.h:96)
+    assert vc.on_values((), [99], (), ts, now=11.0) == 18.0
+
+
+def test_expired_id_list_fires_expired_event():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(10.0)
+    vc.on_values([v(1), v(2)], (), (), ts, now=0.0)
+    events.clear()
+    nxt = vc.on_values((), (), [1], ts, now=1.0)
+    assert events == [([1], True)]
+    assert nxt == 10.0 and len(vc) == 1
+    # expiring an unknown id emits nothing
+    events.clear()
+    vc.on_values((), (), [42], ts, now=1.0)
+    assert events == []
+
+
+def test_one_update_orders_adds_before_expiries():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(10.0)
+    vc.on_values([v(1)], (), (), ts, now=0.0)
+    events.clear()
+    # one packet: new value 2, expired id 1 — two callbacks, adds first
+    vc.on_values([v(2)], (), [1], ts, now=1.0)
+    assert events == [([2], False), ([1], True)]
+
+
+def test_max_values_cap_evicts_oldest_created():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(1e6)
+    # fill to cap with strictly increasing created stamps
+    for i in range(MAX_VALUES):
+        vc.on_values([v(i + 1)], (), (), ts, now=float(i))
+    assert len(vc) == MAX_VALUES
+    events.clear()
+    # two over cap in one update: the two OLDEST-created drop, and the
+    # eviction is reported as an expiration through the callback
+    vc.on_values([v(MAX_VALUES + 1), v(MAX_VALUES + 2)], (), (), ts,
+                 now=float(MAX_VALUES))
+    assert len(vc) == MAX_VALUES
+    adds, drops = events
+    assert adds == ([MAX_VALUES + 1, MAX_VALUES + 2], False)
+    assert drops == ([1, 2], True)
+    assert vc.get_values()                   # newest retained
+    ids = set(x.id for x in vc.get_values())
+    assert 1 not in ids and 2 not in ids and MAX_VALUES + 2 in ids
+
+
+def test_clear_flushes_everything_as_expired():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = types_with(10.0)
+    vc.on_values([v(1), v(2)], (), (), ts, now=0.0)
+    events.clear()
+    vc.clear()
+    assert events == [([1, 2], True)]
+    assert len(vc) == 0
+    # clearing an empty cache fires nothing
+    events.clear()
+    vc.clear()
+    assert events == []
+
+
+def test_callbackless_cache_still_tracks_state():
+    vc = ValueCache(None)
+    ts = types_with(10.0)
+    nxt = vc.on_values([v(1)], (), (), ts, now=0.0)
+    assert nxt == 10.0 and len(vc) == 1
+    assert vc.expire_values(now=10.0) == TIME_MAX and len(vc) == 0
+
+
+def test_mixed_type_expirations_schedule_earliest():
+    events, cb = collector()
+    vc = ValueCache(cb)
+    ts = TypeStore()
+    ts.register_type(ValueType(0, "short", 5.0))
+    ts.register_type(ValueType(7, "long", 50.0))
+    long_v = Value(b"L", type_id=7, value_id=2)
+    nxt = vc.on_values([v(1), long_v], (), (), ts, now=0.0)
+    assert nxt == 5.0
+    events.clear()
+    assert vc.on_values((), (), (), ts, now=5.0) == 50.0
+    assert events == [([1], True)]
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))
