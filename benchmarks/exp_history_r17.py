@@ -1,0 +1,169 @@
+"""Flight-data-recorder on-cost on the 8192-wave search round (round 17).
+
+The round-17 acceptance gate: with a :class:`~opendht_tpu.history.
+MetricsHistory` ticking once per wave (a far HIGHER cadence than the
+production 1 Hz scheduler tick against ~100 ms waves — deliberately
+conservative) AND the on-disk spill armed, the 8192-wave
+iterative-search round must cost < 1% over the recorder-free run.  The
+recorder is host-side snapshot subtraction only — it walks the registry
+families, deltas counters/histogram buckets against the previous tick
+and appends one bounded frame; it never touches the device — so the
+expectation is noise-level.  Measured with the round-9 paired-delta
+methodology and committed as ``captures/history_overhead.json``.
+
+Methodology: both modes run the SAME compiled executable, interleaved
+over ``--reps`` trips with the mode order rotating per rep, and the
+committed number is the MEDIAN OF PER-REP PAIRED differences (pairing
+cancels background-load drift on shared hosts; per-mode medians stay in
+the record so the noise floor is visible).  The driver also pins the
+wave outputs bit-identical between a ticked+spilled trip and an
+untouched trip — the "kernels stay bit-identical with the history tick
++ spill on" acceptance line, checked again in tests/test_history.py.
+
+Usage::
+
+    python benchmarks/exp_history_r17.py --save     # writes capture
+    python benchmarks/exp_history_r17.py --smoke    # CI band check
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-N", type=int, default=0,
+                   help="table rows (default: 1M on accelerator, 128K cpu)")
+    p.add_argument("-W", type=int, default=8192, help="wave width")
+    p.add_argument("--reps", type=int, default=15,
+                   help="timed trips per mode (interleaved)")
+    p.add_argument("--save", action="store_true",
+                   help="write captures/history_overhead.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="assert recorder overhead < 5%% (generous CI "
+                        "band; the committed capture documents the "
+                        "tight number against the <1%% acceptance)")
+    args = p.parse_args(argv)
+
+    import jax
+    from opendht_tpu import telemetry
+    from opendht_tpu.history import HistoryConfig, MetricsHistory
+    from opendht_tpu.core.search import simulate_lookups
+    from opendht_tpu.ops.sorted_table import (build_prefix_lut, sort_table,
+                                              default_lut_bits)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    N = args.N or (1_000_000 if on_accel else 131_072)
+    W = args.W
+
+    key = jax.random.PRNGKey(17)
+    k1, k2 = jax.random.split(key)
+    table = jax.random.bits(k1, (N, 5), dtype=jax.numpy.uint32)
+    targets = jax.random.bits(k2, (W, 5), dtype=jax.numpy.uint32)
+    sorted_ids, _perm, n_valid = jax.block_until_ready(sort_table(table))
+    lut = jax.block_until_ready(build_prefix_lut(
+        sorted_ids, n_valid, bits=default_lut_bits(N)))
+    del table
+
+    reg = telemetry.get_registry()
+    reg.enabled = True                      # telemetry ON in both modes
+    spill_dir = tempfile.mkdtemp(prefix="odt-history-spill-")
+    import atexit
+    import shutil
+    atexit.register(shutil.rmtree, spill_dir, ignore_errors=True)
+    rec = MetricsHistory(
+        HistoryConfig(period=1.0, capacity=512, spill_dir=spill_dir,
+                      spill_segment_frames=64, spill_max_segments=4),
+        registry=reg)
+    # give the recorder live series to delta over, as a serving node
+    # would have: op counters advance once per wave
+    ops_true = reg.counter("dht_ops_total", op="get", ok="true")
+    op_hist = reg.histogram("dht_op_seconds", op="get")
+
+    def trip(mode: str) -> float:
+        t0 = time.perf_counter()
+        out = simulate_lookups(sorted_ids, n_valid, targets, alpha=3,
+                               k=8, lut=lut, state_limbs=2)
+        jax.block_until_ready(out)
+        if mode == "ticked":
+            ops_true.inc(W)
+            op_hist.observe(0.01)
+            rec.tick()
+        return time.perf_counter() - t0
+
+    # shared warmup: one executable serves both modes
+    for mode in ("ticked", "off"):
+        trip(mode)
+
+    # bit-identity: a ticked+spilled trip and an untouched trip return
+    # the same arrays (the recorder never touches the device)
+    base = jax.block_until_ready(simulate_lookups(
+        sorted_ids, n_valid, targets, alpha=3, k=8, lut=lut,
+        state_limbs=2))
+    rec.tick()
+    ticked = jax.block_until_ready(simulate_lookups(
+        sorted_ids, n_valid, targets, alpha=3, k=8, lut=lut,
+        state_limbs=2))
+    for a, b in zip(jax.tree_util.tree_leaves(base),
+                    jax.tree_util.tree_leaves(ticked)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "wave outputs diverged with the history tick enabled"
+    del base, ticked
+
+    times: dict = {"off": [], "ticked": []}
+    order = ["off", "ticked"]
+    for i in range(args.reps):
+        for mode in order[i % 2:] + order[:i % 2]:
+            times[mode].append(trip(mode))
+
+    # recorder sanity: the timed ticks' frames carry the per-wave deltas
+    assert rec.frames(), "recorder appended no frames"
+    assert any('dht_ops_total{ok="true",op="get"}' in f["counters"]
+               for f in rec.frames())
+
+    on_pct = float(np.median([(s - o) / o for s, o in
+                              zip(times["ticked"], times["off"])])) * 100
+    med = {m: float(np.median(v) * 1e3) for m, v in times.items()}
+    rec_doc = {
+        "name": "history_overhead",
+        "value": round(on_pct, 3),
+        "unit": "percent",
+        "acceptance_pct": 1.0,
+        "wave": W, "N": N, "reps": args.reps,
+        "wave_ms_ticked": round(med["ticked"], 3),
+        "wave_ms_off": round(med["off"], 3),
+        "frames_recorded": len(rec.frames()),
+        "spill_segments": rec.spill_segments,
+        "platform": jax.devices()[0].platform,
+        "note": "8192-wave search round, median of per-rep paired "
+                "deltas over rotation-interleaved trips: flight data "
+                "recorder ticking once per wave (full-registry delta "
+                "frame + on-disk spill armed, live op counters "
+                "advancing) vs no recorder; same executable, "
+                "telemetry on in both modes; wave outputs pinned "
+                "bit-identical",
+    }
+    dc.emit(rec_doc)
+
+    if args.save:
+        dc.write_capture("history_overhead", rec_doc)
+
+    if args.smoke and on_pct >= 5.0:
+        print("history overhead %.2f%% exceeds the 5%% smoke band"
+              % on_pct, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

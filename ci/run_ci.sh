@@ -306,6 +306,40 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "cache overhead smoke failed"
 PY
+# flight-data-recorder smoke (round 17): boot a 3-node real-UDP cluster
+# + proxy, assert dhtmon's windowed invariants read each node's
+# GET /history frames (no scrape-diff wait; pinned equal to the legacy
+# paths), induce an SLO burn and assert a black-box bundle
+# auto-captures with the burn visible in its frames and GET
+# /debug/bundle serving fresh ones, dhtmon --since exits 1 during the
+# burn window then 0 after recovery, the bundle round-trips through the
+# cluster timeline assembler with the health transition present, and
+# the ring + on-disk spill stay bounded under a 10x flood.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.history_smoke import main
+rc = main()
+assert rc == 0, "history smoke failed"
+PY
+# flight-data-recorder overhead smoke (round 17): with the recorder
+# ticking once per wave (full-registry delta frame + spill armed), the
+# search round must stay inside a generous 5% band vs the recorder-free
+# run (the committed captures/history_overhead.json documents the tight
+# number against the <1% acceptance, enforced against the README quote
+# by check_docs above).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_history_r17", pathlib.Path("benchmarks/exp_history_r17.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "history overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

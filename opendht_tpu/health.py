@@ -367,12 +367,30 @@ class HealthEvaluator:
                  tracer: Optional[tracing.Tracer] = None,
                  clock: Callable[[], float] = _time.monotonic,
                  node: str = "",
-                 providers: Optional[Dict[str, Callable]] = None):
+                 providers: Optional[Dict[str, Callable]] = None,
+                 history=None):
         self.cfg = cfg or HealthConfig()
         self.reg = registry or telemetry.get_registry()
         self.tracer = tracer or tracing.get_tracer()
         self.clock = clock
         self.node = node
+        #: round-17 flight data recorder (opendht_tpu/history.py).
+        #: When attached, EVERY windowed delta — SLO windows, the
+        #: scheduler-lag p95, the timeout ratio — reads through its
+        #: retained frames instead of this evaluator's private
+        #: ``_Window`` prior-snapshot state: ONE delta codepath (the
+        #: round-15 ``quantile_from_buckets`` consolidation move,
+        #: applied to the windowing layer), and the evidence the
+        #: verdict was derived from survives in the ring for the
+        #: post-mortem bundle.  The recorder must share this
+        #: evaluator's clock (runtime/runner.py passes the scheduler
+        #: clock to both).
+        self.history = history
+        #: optional hook fired AFTER a verdict transition is recorded:
+        #: ``on_transition(prev, new, report)`` — runtime/runner.py
+        #: captures the black-box bundle here (round 17).  Exceptions
+        #: are swallowed: a broken bundle hook must not kill the tick.
+        self.on_transition: Optional[Callable] = None
         # node-keyed export labels: co-resident nodes share the process
         # registry (round-8 semantics), so an unlabeled verdict gauge
         # would be last-writer-wins across nodes; standalone evaluators
@@ -428,16 +446,43 @@ class HealthEvaluator:
         dbuckets = _sub_buckets(cur[1], base[1])
         return dtotal, _count_over(dbuckets, st.obj.threshold_s)
 
+    def _slo_window_hist(self, st: _SloState, now: float,
+                         window: float) -> Optional[tuple]:
+        """Windowed ``(total, bad)`` read through the attached history
+        recorder's frames (round 17) — same None-before-coverage
+        contract as :meth:`_slo_window`.  Series names are the exact
+        Prometheus forms the recorder keys frames by (labels sorted,
+        telemetry._series_name)."""
+        o = st.obj
+        t0 = now - window
+        if o.kind == "availability":
+            ok = self.history.counter_delta(
+                'dht_ops_total{ok="true",op="%s"}' % o.op, t0, now)
+            if ok is None:        # no frame covers the window yet
+                return None
+            bad = self.history.counter_delta(
+                'dht_ops_total{ok="false",op="%s"}' % o.op, t0, now) or 0.0
+            return ok + bad, bad
+        d = self.history.hist_delta('dht_op_seconds{op="%s"}' % o.op,
+                                    t0, now)
+        if d is None:
+            return None
+        count, _sum, buckets = d
+        return count, _count_over(buckets, o.threshold_s)
+
     def _eval_slo(self, st: _SloState, now: float) -> None:
         cfg = self.cfg
-        st.win.push(now, self._slo_sample(st))
+        if self.history is None:
+            st.win.push(now, self._slo_sample(st))
         budget = max(1.0 - st.obj.objective, 1e-9)
         burns = {}
         clears = {}
         any_data = False
         for wname, wlen in (("fast", cfg.fast_window),
                             ("slow", cfg.slow_window)):
-            w = self._slo_window(st, now, wlen)
+            w = (self._slo_window_hist(st, now, wlen)
+                 if self.history is not None
+                 else self._slo_window(st, now, wlen))
             total, bad = w if w is not None else (0.0, 0.0)
             if w is not None and total >= cfg.min_events:
                 any_data = True
@@ -486,6 +531,23 @@ class HealthEvaluator:
     def _builtin_signals(self, now: float) -> Dict[str, Optional[float]]:
         cfg = self.cfg
         out: Dict[str, Optional[float]] = {}
+        if self.history is not None:
+            # round 17: the same two windowed signals, read through the
+            # recorder's frames (family-prefix matching folds the
+            # type-labeled request series exactly like the series()
+            # sums below) — no private window state
+            t0 = now - cfg.fast_window
+            out["scheduler_lag"] = self.history.quantile(
+                "dht_scheduler_tick_lag_seconds", 0.95, t0, now)
+            dsent = self.history.counter_delta(
+                "dht_net_requests_sent_total", t0, now)
+            dexp = self.history.counter_delta(
+                "dht_net_requests_expired_total", t0, now)
+            ratio = None
+            if dsent is not None and dsent >= cfg.min_events:
+                ratio = max(dexp or 0.0, 0.0) / dsent
+            out["timeout_ratio"] = ratio
+            return out
         # scheduler tick lag: windowed p95 of the round-8 histogram
         count, _s, buckets = self.reg.histogram(
             "dht_scheduler_tick_lag_seconds").raw()
@@ -574,13 +636,13 @@ class HealthEvaluator:
             [n for n, s in signals.items() if s["unknown"]]
             + [st.obj.name for st in self._slos
                if st.detail.get("unknown")])
+        prev_verdict = self._verdict
         if worst != self._verdict:
-            prev = self._verdict
             self._verdict = worst
             self._since = now
             if self.tracer.enabled:
                 self.tracer.event("health_transition", node=self.node,
-                                  **{"from": prev, "to": worst,
+                                  **{"from": prev_verdict, "to": worst,
                                      "causes": sorted(set(causes))})
         self.reg.gauge("dht_health_status", **self._labels).set(
             _RANK[worst])
@@ -594,6 +656,11 @@ class HealthEvaluator:
             "unknown": unknown,
         }
         self._report = report
+        if worst != prev_verdict and self.on_transition is not None:
+            try:
+                self.on_transition(prev_verdict, worst, report)
+            except Exception:
+                log.exception("health on_transition hook failed")
         return report
 
     def report(self) -> dict:
@@ -617,12 +684,13 @@ class NodeHealth:
     attaches one per node when ``Config.health.period > 0``)."""
 
     def __init__(self, dht, cfg: Optional[HealthConfig] = None,
-                 node: str = ""):
+                 node: str = "", history=None):
         self._dht = dht
         self._node_id = str(getattr(dht, "myid", "") or "")
         self.cfg = cfg or HealthConfig()
         self.evaluator = HealthEvaluator(
             self.cfg, clock=dht.scheduler.time, node=node,
+            history=history,
             providers={
                 "connectivity": self._connectivity,
                 "ingest_queue": self._ingest_queue,

@@ -34,6 +34,7 @@ from __future__ import annotations
 import concurrent.futures
 import json
 import queue
+import re
 import socket
 import threading
 import time
@@ -52,6 +53,14 @@ from .json_codec import value_to_json, value_from_json, permanent_deadline
 OP_TIMEOUT = 60 * 60.0
 OP_MARGIN = 5 * 60.0
 STATS_PERIOD = 120.0            # dht_proxy_server.cpp:138-148
+
+# strict query-param grammars for the round-17 history/trace routes: a
+# bare int()/float() accepts Python literal niceties — digit-group
+# underscores ('1_5'), sign prefixes ('+5'), surrounding whitespace,
+# 'nan'/'inf' — that the malformed-param 400 contract must reject
+# (review finding; the same leniency _trace_hex was hardened against)
+_Q_INT = re.compile(r"^\d+$")
+_Q_NUM = re.compile(r"^\d+(?:\.\d+)?$")
 
 
 class ServerStats:
@@ -416,6 +425,41 @@ def _make_handler(server: DhtProxyServer):
                 # any internal failure — no second wrapper here
                 self._send_json(runner.get_cache())
                 return
+            if parts == ["history"]:
+                # GET /history[?since=SEC][&limit=N] → the round-17
+                # flight data recorder's retained frames (delta-encoded
+                # registry history) with the server clocks for skew
+                # estimation — what dhtmon --window/--since and the
+                # timeline assembler consume instead of
+                # scrape-diff-scrape.  "history" is not a valid hash,
+                # so — like /stats — the path was previously a 400 and
+                # stays unambiguous.
+                since = limit = None
+                sq = (_q.get("since") or [None])[0]
+                lq = (_q.get("limit") or [None])[0]
+                if sq is not None:
+                    if not _Q_NUM.match(sq):
+                        self._err(400, "invalid since/limit")
+                        return
+                    since = float(sq)
+                if lq is not None:
+                    if not _Q_INT.match(lq):
+                        self._err(400, "invalid since/limit")
+                        return
+                    limit = int(lq)
+                self._send_json(runner.get_history(since=since,
+                                                   limit=limit))
+                return
+            if parts == ["debug", "bundle"]:
+                # GET /debug/bundle → a fresh post-mortem black-box
+                # bundle (round 17): last-N history frames + flight
+                # ring + kernel ledger + keyspace/cache snapshots in
+                # one artifact (summaries of the auto-captured bundles
+                # ride along under "auto_captures").  "debug" is not a
+                # valid hash, so the path was previously a 400 and
+                # stays unambiguous.
+                self._send_json(runner.dump_bundle())
+                return
             if parts[0] == "trace":
                 # GET /trace[?name=] → the node's flight-recorder dump
                 # (ISSUE-4; the reference's dumpTables as a scrapeable
@@ -427,10 +471,26 @@ def _make_handler(server: DhtProxyServer):
                 # Perfetto-loadable Chrome dump with ?fmt=chrome.
                 # "trace" is not a valid hash, so — like /stats — the
                 # path was previously a 400 and stays unambiguous.
+                # ?limit=N pagination (round-17 satellite): a full ring
+                # dump over the proxy was unbounded; limit keeps the
+                # NEWEST N spans and events.  Malformed (non-integer /
+                # negative) limits are a 400, matching the
+                # malformed-trace-id contract below.
+                limit = None
+                lq = (_q.get("limit") or [None])[0]
+                if lq is not None:
+                    if not _Q_INT.match(lq):
+                        self._err(400, "invalid limit")
+                        return
+                    limit = int(lq)
                 tr = tracing.get_tracer()
                 if len(parts) == 1:
-                    self._send_json(tr.dump(
-                        name=(_q.get("name") or [None])[0]))
+                    d = tr.dump(name=(_q.get("name") or [None])[0])
+                    if limit is not None:
+                        d["spans"] = d["spans"][-limit:] if limit else []
+                        d["events"] = d["events"][-limit:] if limit else []
+                        d["limit"] = limit
+                    self._send_json(d)
                     return
                 # a malformed (non-hex / oversized) trace id is a 400,
                 # not an empty span list — only a WELL-FORMED unknown
@@ -439,11 +499,16 @@ def _make_handler(server: DhtProxyServer):
                 if tracing._trace_hex(parts[1]) is None:
                     self._err(400, "invalid trace id")
                 elif _q.get("fmt", [""])[0] == "chrome":
-                    self._send_json(tracing.to_chrome_trace(
-                        tr.spans(parts[1])))
+                    spans = tr.spans(parts[1])
+                    if limit is not None:
+                        spans = spans[-limit:] if limit else []
+                    self._send_json(tracing.to_chrome_trace(spans))
                 else:
+                    spans = tr.spans(parts[1])
+                    if limit is not None:
+                        spans = spans[-limit:] if limit else []
                     self._send_json({"trace_id": parts[1],
-                                     "spans": tr.spans(parts[1])})
+                                     "spans": spans})
                 return
             key = self._hash_arg(parts)
             if key is None:

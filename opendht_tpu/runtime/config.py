@@ -12,6 +12,7 @@ from typing import Optional
 # re-exported here because runtime/config.py is where node behavior is
 # configured — `Config.health` is the knob surface
 from ..health import HealthConfig, SloObjective, default_slos  # noqa: F401
+from ..history import HistoryConfig  # noqa: F401  (same knob-surface rule)
 from ..keyspace import KeyspaceConfig  # noqa: F401  (same knob-surface rule)
 from ..hotcache import HotCacheConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
@@ -104,6 +105,23 @@ class Config:
     #: events, and the proxy's readiness route ``GET /healthz``.
     #: ``health.period = 0`` disables the tick entirely.
     health: HealthConfig = field(default_factory=HealthConfig)
+
+    # --- flight data recorder (round 17, opendht_tpu/history.py) ------
+    #: bounded ring of periodic delta-encoded registry frames (counters
+    #: as deltas, histograms as bucket deltas, gauges as last-value)
+    #: ticking on the node scheduler, with windowed ``rate``/
+    #: ``quantile`` queries, optional bounded on-disk spill
+    #: (``history.spill_dir``), and post-mortem black-box bundles —
+    #: auto-captured on every health transition to unhealthy, served
+    #: fresh by ``DhtRunner.dump_bundle()`` / proxy ``GET
+    #: /debug/bundle`` / the ``bundle`` REPL cmd / ``dhtscanner
+    #: --bundle DIR``.  When the recorder is live, the health engine's
+    #: windowed SLO deltas read THROUGH its frames (one delta
+    #: codepath) and ``dhtmon --window/--since`` query ``GET
+    #: /history`` instead of scrape-diff-scrape.  ``history.period =
+    #: 0`` disables the recorder (surfaces report ``enabled: false``;
+    #: the health engine falls back to its private windows).
+    history: HistoryConfig = field(default_factory=HistoryConfig)
 
     # --- keyspace traffic observatory (round 15, opendht_tpu/keyspace.py) --
     #: device-resident count-min sketch + 256-bin keyspace histogram

@@ -32,7 +32,7 @@ import threading
 import time as _time
 from typing import Callable, List, Optional, Tuple
 
-from .. import health as _health, telemetry, tracing
+from .. import health as _health, history as _history, telemetry, tracing
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
 from ..utils import TIME_MAX, lazy_module
@@ -139,6 +139,7 @@ class DhtRunner:
     def __init__(self):
         self._dht: Optional[SecureDht] = None
         self._health: "_health.NodeHealth | None" = None
+        self._history: "_history.MetricsHistory | None" = None
         self._sock4: Optional[_socket.socket] = None
         self._sock6: Optional[_socket.socket] = None
         self._udp = None                       # native UdpEngine (IPv4)
@@ -199,14 +200,50 @@ class DhtRunner:
         dht.status_cb = lambda s4, s6: None   # runner tracks status itself
         dht.warmup()     # compile hot kernels before serving any packet
 
+        # flight data recorder (round 17): the bounded ring of
+        # delta-encoded registry frames, ticking on the node scheduler
+        # ahead of the health job so a health window never reads frames
+        # more than one period stale (host-side subtraction only — no
+        # device work, kernels untouched)
+        self._history = None
+        hcfg = dht_config.history
+        if hcfg.period > 0 and hcfg.capacity > 0:
+            # the ring is frame-count-bounded while the SLO windows the
+            # health engine reads through it are TIME-bounded: at a
+            # short recorder period the default capacity would silently
+            # truncate the slow-burn window (the private _Window kept
+            # slow_window * 1.25 by time regardless of cadence), so
+            # scale the capacity up to cover it (review finding)
+            if dht_config.health.period > 0:
+                import dataclasses
+                import math as _math
+                need = int(_math.ceil(
+                    dht_config.health.slow_window * 1.25 / hcfg.period))
+                if hcfg.capacity < need:
+                    log.info("history capacity %d < slow SLO window "
+                             "coverage at period %gs; raising to %d",
+                             hcfg.capacity, hcfg.period, need)
+                    hcfg = dataclasses.replace(hcfg, capacity=need)
+            self._history = _history.MetricsHistory(
+                hcfg, clock=dht.scheduler.time,
+                node=str(dht.get_node_id()))
+            self._history.attach(dht.scheduler)
+
         # health observatory (round 14): the declarative SLO engine +
         # node verdict, evaluated on a periodic scheduler tick riding
         # the same DHT thread as every other job (host-side snapshot
-        # subtraction only — no device work, kernels untouched)
+        # subtraction only — no device work, kernels untouched).  With
+        # the recorder live, every windowed delta reads through its
+        # frames (round 17 — one delta codepath) and an unhealthy
+        # transition captures a black-box bundle.
         self._health = None
         if dht_config.health.period > 0:
             self._health = _health.NodeHealth(
-                dht, dht_config.health, node=str(dht.get_node_id()))
+                dht, dht_config.health, node=str(dht.get_node_id()),
+                history=self._history)
+            if self._history is not None:
+                self._health.evaluator.on_transition = \
+                    self._on_health_transition
             self._health.attach(dht.scheduler)
 
         self.running = True
@@ -827,6 +864,87 @@ class DhtRunner:
         rep = dict(h.report())
         rep["enabled"] = True
         return rep
+
+    def get_history(self, since: Optional[float] = None,
+                    limit: Optional[int] = None) -> dict:
+        """The flight data recorder's retained frames (round 17): the
+        JSON the proxy's ``GET /history`` route serves and ``dhtmon
+        --window/--since`` evaluate windowed invariants over.
+        ``since`` keeps frames from the last SEC seconds (recorder
+        clock), ``limit`` the newest N.  The envelope carries the
+        server's wall/mono clocks so the cluster timeline assembler
+        can estimate scrape skew."""
+        h = self._history
+        if h is None:
+            return {"enabled": False, "frames": []}
+        t0 = (h.clock() - since) if since is not None else None
+        doc = h.meta()
+        doc["node_id"] = self.get_node_id().hex()
+        doc["time"] = _time.time()
+        doc["mono"] = h.clock()
+        doc["frames"] = h.frames(t0=t0, limit=limit)
+        return doc
+
+    def dump_bundle(self, reason: str = "on_demand", *,
+                    refresh: bool = True) -> dict:
+        """Assemble one post-mortem black-box bundle (round 17): the
+        last N history frames + the flight-recorder ring (spans AND
+        events) + kernel ledger + keyspace/cache/ingest snapshots +
+        the health report in ONE JSON artifact — the reference's
+        ``dumpTables`` instant, retained and machine-readable.  Served
+        by proxy ``GET /debug/bundle``, the ``bundle`` REPL cmd and
+        ``dhtscanner --bundle DIR``; captured automatically (with
+        ``refresh=False``) on every health transition to unhealthy.
+
+        ``refresh=False`` skips the routing-gauge refresh, which posts
+        to the DHT thread and waits — REQUIRED when called FROM that
+        thread (the health tick's transition hook), where the wait
+        would deadlock."""
+        metrics: dict = {}
+        try:
+            metrics = (self.get_metrics() if refresh
+                       else telemetry.get_registry().snapshot())
+        except Exception:
+            pass
+        ingest: dict = {}
+        try:
+            ingest = self._dht.wave_builder.snapshot()
+        except Exception:
+            pass
+        return _history.build_bundle(
+            reason=reason,
+            node_id=self.get_node_id().hex(),
+            status=self.get_status().name,
+            history=self._history,
+            health=self.get_health(),
+            metrics=metrics,
+            keyspace=self.get_keyspace(),
+            cache=self.get_cache(),
+            ingest=ingest,
+        )
+
+    def get_bundles(self) -> list:
+        """Auto-captured black-box bundles (newest last; bounded by
+        ``history.retain_bundles``) — the evidence retained from past
+        unhealthy transitions."""
+        return self._history.bundles() if self._history is not None else []
+
+    def _on_health_transition(self, prev: str, new: str,
+                              report: dict) -> None:
+        """Evaluator transition hook (runs ON the DHT thread inside
+        the health tick): capture the black-box bundle the moment the
+        verdict goes unhealthy — by the time a human looks, the
+        counters have moved on but the bundle has the frames."""
+        if new != _health.UNHEALTHY or self._history is None:
+            return
+        try:
+            b = self.dump_bundle(reason="health_transition",
+                                 refresh=False)
+            b["transition"] = {"from": prev, "to": new,
+                               "causes": report.get("causes", [])}
+            self._history.store_bundle(b)
+        except Exception:
+            log.exception("black-box bundle capture failed")
 
     def get_keyspace(self) -> dict:
         """The keyspace traffic observatory snapshot (ISSUE-10): the

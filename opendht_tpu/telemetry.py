@@ -336,6 +336,15 @@ class MetricsRegistry:
                 if self.enabled and record else None)
         return _SpanCtx(hist, name)
 
+    def families(self) -> Dict[str, str]:
+        """``{family_name: kind}`` of every registered metric family —
+        the non-mutating enumeration the round-17 history recorder
+        walks each tick (``snapshot()`` would compute quantiles for
+        every histogram in the process; the recorder only needs names
+        to feed :meth:`series`)."""
+        with self._lock:
+            return {n: kind for n, (kind, _d) in self._metrics.items()}
+
     def series(self, name: str) -> dict:
         """All label series of one metric family as ``{label_key:
         metric}`` (empty when the family was never written).  Lets a

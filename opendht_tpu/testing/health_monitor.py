@@ -78,6 +78,41 @@ def scrape_node(endpoint: str, timeout: float = 10.0) -> dict:
     }
 
 
+def scrape_history(endpoint: str, since: float,
+                   timeout: float = 10.0) -> Optional[dict]:
+    """One node's ``GET /history?since=SEC`` document (round 17), with
+    the LOCAL wall clock stamped as ``scraped_at`` so the timeline
+    assembler can estimate skew.  ``None`` when the node does not
+    export history (route missing, scrape error, or recorder disabled)
+    — the caller's signal to fall back to scrape-diff-scrape."""
+    base = "http://" + endpoint.rstrip("/")
+    try:
+        with urllib.request.urlopen(
+                base + "/history?since=%g" % since, timeout=timeout) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    if not isinstance(doc, dict) or not doc.get("enabled"):
+        return None
+    doc["endpoint"] = endpoint
+    doc["scraped_at"] = time.time()
+    return doc
+
+
+def merge_history_series(histories: Iterable[dict]) -> Dict[str, float]:
+    """Sum every node's history frames into ONE windowed series map of
+    the exact shape :func:`merge_series` builds from ``GET /stats``
+    scrapes — so :func:`lookup_success` / :func:`cluster_quantile`
+    evaluate windowed invariants over history through the same code
+    path as the scrape-diff mode (one delta codepath, round 17)."""
+    from ..history import frames_to_series
+    out: Dict[str, float] = {}
+    for h in histories:
+        for k, v in frames_to_series(h.get("frames") or []).items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
 def merge_series(scrapes: Iterable[dict]) -> Dict[str, float]:
     """Sum every Prometheus series across node scrapes (counters and
     cumulative buckets sum; the cluster invariants below only read

@@ -44,7 +44,7 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                min_coverage: Optional[float] = None,
                require_ready: bool = False, op: str = "get",
                sample_max: int = 64, k: int = 8, mesh=None,
-               window: float = 0.0,
+               window: float = 0.0, since: Optional[float] = None,
                max_imbalance: Optional[float] = None,
                min_cache_hit: Optional[float] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
@@ -52,17 +52,26 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     human-readable invariant failures (empty = healthy).
 
     ``window > 0`` evaluates the success/latency invariants over a
-    WINDOW: scrape, wait ``window`` seconds, scrape again, and diff
-    the cumulative series (the node evaluator's snapshot-subtraction
-    move, cluster-side).  The default (0) reads the since-boot
-    cumulative ratio — right for a CI smoke's bounded lifetime, wrong
-    for a week-old soak, where lifetime counters both hide a fresh
-    outage and remember a recovered one forever (review finding).
+    WINDOW.  Since round 17 the PREFERRED source is each node's
+    ``GET /history`` endpoint (the flight data recorder's retained
+    delta frames: no second scrape, no wait — the window already
+    happened); only when a node does not export history does the check
+    fall back to the legacy scrape-diff-scrape (scrape, wait
+    ``window`` seconds, scrape again, diff the cumulative series).
+    Both sources feed the SAME invariant code (``lookup_success`` /
+    ``cluster_quantile`` over one summed series map — pinned equal in
+    tests/test_history.py).  ``since`` is the strict form: evaluate
+    over the last ``since`` seconds of HISTORY ONLY, raising when any
+    node lacks the endpoint (no silent wait) — the soak/CI gate form.
+    The default (0) reads the since-boot cumulative ratio — right for
+    a CI smoke's bounded lifetime, wrong for a week-old soak, where
+    lifetime counters both hide a fresh outage and remember a
+    recovered one forever (review finding).
     ONLY the success/latency invariants window: readiness, the
     replica-coverage probe and the imbalance gauge are point-in-time
     by nature, so when no windowed invariant is requested
-    (``min_success`` unset and no ``alerts``) the baseline scrape and
-    the wait are skipped entirely (ISSUE-10 satellite — a
+    (``min_success`` unset and no ``alerts``) the history/baseline
+    scrape and any wait are skipped entirely (ISSUE-10 satellite — a
     coverage-only ``--window`` run used to scrape every node twice
     for nothing).
 
@@ -79,18 +88,54 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
+    hist_series = None
+    window_source = None
     windowed = min_success is not None or bool(alerts)
-    if window > 0 and endpoints and windowed:
-        baseline = hm.merge_series([hm.scrape_node(ep)
-                                    for ep in endpoints])
-        time.sleep(window)
+    if since is not None and not since > 0:
+        # a non-positive --since would silently fall through to the
+        # since-boot cumulative evaluation — the exact failure mode
+        # --since exists to prevent; refuse loudly (exit 2 in main)
+        raise ValueError("--since must be a positive window (got %g)"
+                         % since)
+    if since is not None and not endpoints:
+        # runners-only invocations have no GET /history to read; a
+        # silent skip would report a windowed gate passed when nothing
+        # was evaluated (review finding)
+        raise ValueError("--since requires proxy endpoints exporting "
+                         "GET /history")
+    win = since if since is not None else window
+    if windowed and endpoints and win > 0:
+        # round 17: the history endpoint IS the window — no second
+        # scrape, no wait.  All-or-nothing across nodes: a mixed
+        # cluster would double-count traffic if half the series were
+        # windowed deltas and half cumulative diffs.
+        hists = []
+        for ep in endpoints:
+            h = hm.scrape_history(ep, win)
+            if h is None:
+                hists = None
+                break
+            hists.append(h)
+        if hists is not None:
+            hist_series = hm.merge_history_series(hists)
+            window_source = "history"
+        elif since is not None:
+            raise RuntimeError(
+                "--since requires every node to export GET /history "
+                "(the round-17 flight data recorder)")
+        else:
+            baseline = hm.merge_series([hm.scrape_node(ep)
+                                        for ep in endpoints])
+            time.sleep(window)
+            window_source = "scrape-diff"
     scrapes = []
     for ep in endpoints:
         scrapes.append(hm.scrape_node(ep))
     doc: dict = {
         "nodes": [{"endpoint": s["endpoint"], "ready": s["ready"],
                    "verdict": s["verdict"]} for s in scrapes],
-        "window_s": (window or None) if windowed else None,
+        "window_s": (win or None) if windowed else None,
+        "window_source": window_source,
     }
     if require_ready:
         for s in scrapes:
@@ -98,7 +143,12 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                 violations.append("node %s not ready (verdict %s)"
                                   % (s["endpoint"], s["verdict"]))
     series = hm.merge_series(scrapes) if scrapes else {}
-    if baseline is not None:
+    if hist_series is not None:
+        # the recorder's summed frame deltas have the same shape as a
+        # scrape diff (history.frames_to_series) — the invariant code
+        # below cannot tell the sources apart
+        series = hist_series
+    elif baseline is not None:
         # cumulative counters and cumulative-by-le buckets both diff
         # cleanly; only the summed counter/bucket series are read below
         series = {key: max(v - baseline.get(key, 0.0), 0.0)
@@ -199,15 +249,24 @@ def main(argv=None) -> int:
                         "(default: get)")
     p.add_argument("--window", type=float, default=0.0, metavar="SEC",
                    help="evaluate the SUCCESS/LATENCY invariants over "
-                        "a SEC-second window (scrape, wait, scrape, "
-                        "diff) instead of the since-boot cumulative — "
-                        "use for long-lived clusters, where lifetime "
-                        "ratios hide fresh outages and remember "
-                        "recovered ones.  Readiness, the replica-"
-                        "coverage probe and --max-imbalance are "
-                        "point-in-time and unaffected; with no "
-                        "windowed invariant requested the second "
-                        "scrape is skipped entirely")
+                        "a SEC-second window instead of the "
+                        "since-boot cumulative — use for long-lived "
+                        "clusters, where lifetime ratios hide fresh "
+                        "outages and remember recovered ones.  Reads "
+                        "each node's GET /history frames (round-17 "
+                        "flight data recorder: no wait) when every "
+                        "node exports them, falling back to scrape-"
+                        "wait-scrape-diff otherwise.  Readiness, the "
+                        "replica-coverage probe and --max-imbalance "
+                        "are point-in-time and unaffected; with no "
+                        "windowed invariant requested the extra "
+                        "scrapes are skipped entirely")
+    p.add_argument("--since", type=float, default=None, metavar="SEC",
+                   help="like --window, but STRICTLY from the nodes' "
+                        "GET /history frames over the last SEC "
+                        "seconds — exits 2 when any node lacks the "
+                        "recorder instead of silently waiting out a "
+                        "scrape-diff window (the soak/CI gate form)")
     p.add_argument("--max-imbalance", type=float, default=None,
                    metavar="R",
                    help="fail when any node's keyspace shard-load "
@@ -241,7 +300,8 @@ def main(argv=None) -> int:
         violations, doc = run_checks(
             endpoints, alerts=alerts, min_success=args.min_success,
             require_ready=args.require_ready, op=args.op,
-            window=args.window, max_imbalance=args.max_imbalance,
+            window=args.window, since=args.since,
+            max_imbalance=args.max_imbalance,
             min_cache_hit=args.min_cache_hit)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
@@ -253,6 +313,9 @@ def main(argv=None) -> int:
         for n in doc["nodes"]:
             print("node %s: %s%s" % (n["endpoint"], n["verdict"],
                                      "" if n["ready"] else " (NOT READY)"))
+        if doc.get("window_source"):
+            print("window: %gs via %s" % (doc["window_s"],
+                                          doc["window_source"]))
         ls = doc.get("lookup_success")
         if ls:
             print("lookup success: %.4f over %d ops"

@@ -60,6 +60,15 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            reference's dumpTables analogue); a
                            non-numeric arg filters by event/span name
                            substring (e.g. 'dump health')
+    bundle [file]          post-mortem black-box bundle (round 17):
+                           last-N history frames + flight-recorder
+                           ring + kernel ledger + keyspace/cache
+                           snapshots + health report in one JSON
+                           artifact — the same document the proxy
+                           serves on GET /debug/bundle; with a file
+                           arg the bundle is written there, otherwise
+                           a summary prints (auto-captured bundles
+                           from past unhealthy transitions listed)
     stt <port>             start REST proxy server
     stp                    stop REST proxy server
     pst <host:port>        switch backend to a REST proxy (client)
@@ -296,6 +305,32 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                                  else "", ent["ttl_s"]))
                     if not snap["entries"]:
                         print("  (no hot keys cached yet)")
+            elif op == "bundle":
+                # post-mortem black-box bundle (round 17): same
+                # artifact the proxy serves on GET /debug/bundle
+                import json as _json
+                b = node.dump_bundle()
+                if rest:
+                    with open(rest[0], "w") as fh:
+                        _json.dump(b, fh, indent=1, sort_keys=True)
+                    print("bundle written to %s" % rest[0])
+                h = b.get("history", {})
+                print("bundle: %d history frame(s) (period %ss), %d "
+                      "flight event(s) + %d span(s), verdict %s" % (
+                          len(h.get("frames", [])),
+                          h.get("period", "?"),
+                          len(b["flight_recorder"]["events"]),
+                          len(b["flight_recorder"]["spans"]),
+                          b.get("health", {}).get("verdict", "unknown")))
+                for a in b.get("auto_captures", []):
+                    tr_ = a.get("transition") or {}
+                    print("  auto-captured %s: %s -> %s (causes %s)" % (
+                        time.strftime("%H:%M:%S",
+                                      time.localtime(a.get("time", 0))),
+                        tr_.get("from", "?"), tr_.get("to", "?"),
+                        ", ".join(tr_.get("causes", [])) or "-"))
+                if not b.get("auto_captures"):
+                    print("  (no auto-captured bundles retained)")
             elif op == "dump":
                 import json as _json
                 n, name = 40, None

@@ -165,6 +165,13 @@ def main(argv=None) -> int:
                         "one-time lowering) so the snapshot's 'kernels' "
                         "section carries per-kernel flops/bytes/HBM "
                         "footprint")
+    p.add_argument("--bundle", default="", metavar="DIR",
+                   help="collect the scanning node's post-mortem "
+                        "black-box bundle (round 17: last-N history "
+                        "frames + flight ring + kernel ledger + "
+                        "keyspace/cache snapshots — the GET "
+                        "/debug/bundle artifact) into "
+                        "DIR/bundle-<nodeid>.json after the scan")
     args = p.parse_args(argv)
     if args.kernels:
         from .. import profiling
@@ -182,6 +189,21 @@ def main(argv=None) -> int:
             time.sleep(0.1)
         seen = scan(node, args.rounds, quiet=args.json)
         stats = node.get_node_stats(socket.AF_INET)
+        bundle_path = None
+        if args.bundle:
+            # black-box collector (round 17): the scan drove real
+            # traffic, so the bundle's history frames carry it — one
+            # artifact per node for the cluster harness to merge
+            # through testing/timeline_assembler.py
+            import os
+            os.makedirs(args.bundle, exist_ok=True)
+            bundle_path = os.path.join(
+                args.bundle,
+                "bundle-%s.json" % node.get_node_id().hex())
+            with open(bundle_path, "w") as fh:
+                json.dump(node.dump_bundle(reason="dhtscanner"), fh)
+            if not args.json:
+                print("bundle written to %s" % bundle_path)
         if args.json:
             doc = {
                 "snapshot": topology_snapshot(node),
@@ -191,6 +213,7 @@ def main(argv=None) -> int:
                     key=lambda kv: kv[0]),
                 "network_size_estimation":
                     stats.get_network_size_estimation(),
+                "bundle_path": bundle_path,
             }
             json.dump(doc, sys.stdout)
             print()

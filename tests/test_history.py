@@ -1,0 +1,429 @@
+"""Flight data recorder (round 17): the metrics history ring, windowed
+queries, spill bounds, black-box bundles, the health engine's
+one-delta-codepath integration, and the cluster timeline assembler."""
+
+import json
+import os
+import time
+
+import pytest
+
+from opendht_tpu import health, history, telemetry
+from opendht_tpu.history import (HistoryConfig, MetricsHistory,
+                                 build_bundle, frames_to_series)
+from opendht_tpu.testing import health_monitor as hm
+from opendht_tpu.testing import timeline_assembler as ta
+
+
+def _recorder(capacity=8, **kw):
+    reg = telemetry.MetricsRegistry()
+    clock = [0.0]
+    cfg = HistoryConfig(period=1.0, capacity=capacity, **kw)
+    h = MetricsHistory(cfg, registry=reg, clock=lambda: clock[0])
+    return reg, clock, h
+
+
+# ================================================================= ring
+def test_first_tick_is_baseline_only():
+    """The first tick must not report the node's whole lifetime as one
+    frame — it establishes the cumulative baseline and appends
+    nothing."""
+    reg, clock, h = _recorder()
+    reg.counter("h_boot_total").inc(1000)     # pre-recorder lifetime
+    assert h.tick() is None
+    assert h.frames() == []
+    clock[0] = 1.0
+    reg.counter("h_boot_total").inc(3)
+    f = h.tick()
+    assert f["counters"]["h_boot_total"] == 3     # not 1003
+
+
+def test_ring_bounded_oldest_evicted():
+    reg, clock, h = _recorder(capacity=8)
+    c = reg.counter("h_flood_total")
+    h.tick()
+    for i in range(80):                      # 10x capacity
+        clock[0] += 1.0
+        c.inc(1)
+        h.tick()
+    frames = h.frames()
+    assert len(frames) == 8
+    # oldest evicted: the survivors are the NEWEST 8
+    assert [f["seq"] for f in frames] == list(range(73, 81))
+
+
+def test_counter_reset_and_gauge_last_value():
+    reg, clock, h = _recorder()
+    c = reg.counter("h_reset_total")
+    g = reg.gauge("h_gauge")
+    c.inc(5)
+    g.set(2.0)
+    h.tick()
+    clock[0] = 1.0
+    c.inc(2)
+    f1 = h.tick()
+    assert f1["counters"]["h_reset_total"] == 2
+    assert "h_gauge" not in f1["gauges"]      # unchanged → not recorded
+    clock[0] = 2.0
+    reg.reset()                               # counters rewind in place
+    c.inc(4)
+    g.set(9.0)
+    f2 = h.tick()
+    # post-reset the new cumulative IS the window's events
+    assert f2["counters"]["h_reset_total"] == 4
+    assert f2["gauges"]["h_gauge"] == 9.0     # changed → last-value
+
+
+def test_windowed_rate_and_quantile():
+    reg, clock, h = _recorder(capacity=16)
+    c = reg.counter("h_ops_total", op="get", ok="true")
+    hist = reg.histogram("h_sec", op="get")
+    h.tick()
+    for i in range(6):
+        clock[0] += 1.0
+        c.inc(10)
+        hist.observe(0.5 if i < 3 else 8.0)
+        h.tick()
+    # family AND exact-series matching
+    assert h.counter_delta("h_ops_total", 0.0, 6.0) == 60
+    assert h.counter_delta('h_ops_total{ok="true",op="get"}',
+                           3.0, 6.0) == 30
+    assert h.rate("h_ops_total", 2.0, 6.0) == pytest.approx(10.0)
+    # the early window saw only 0.5 s observations, the late only 8 s
+    assert h.quantile("h_sec", 0.5, 0.0, 3.0) <= 0.5
+    assert h.quantile("h_sec", 0.5, 3.0, 6.0) > 4.0
+    # no coverage → None (the round-14 "window not computable" contract)
+    assert h.counter_delta("h_ops_total", 100.0, 200.0) is None
+    assert h.quantile("h_sec", 0.5, 100.0, 200.0) is None
+    assert h.rate("h_ops_total", 100.0, 200.0) is None
+    # limit contract: 0 means NONE, not "unlimited" (review finding —
+    # the proxy routes accept 0 and must get an empty page)
+    assert h.frames(limit=0) == []
+    assert len(h.frames(limit=2)) == 2
+
+
+def test_default_capacity_covers_slow_slo_window():
+    """The default ring must retain at least the health engine's slow
+    SLO window x its 1.25 keep slack at the default periods — a
+    shorter ring silently truncates slow-burn windows to partial
+    totals (review finding)."""
+    hc = HistoryConfig()
+    slo = health.HealthConfig()
+    assert hc.capacity * hc.period >= slo.slow_window * 1.25
+
+
+def test_frames_json_roundtrip():
+    """Frames survive JSON (proxy route, bundle files, spill segments):
+    bucket keys stringify and the query readers re-normalize."""
+    reg, clock, h = _recorder()
+    hist = reg.histogram("h_rt_sec")
+    h.tick()
+    clock[0] = 1.0
+    hist.observe(2.0)
+    h.tick()
+    rt = json.loads(json.dumps(h.frames()))
+    s = frames_to_series(rt)
+    assert any("h_rt_sec_bucket" in k for k in s)
+    q = ta.window_series(ta.assemble_timeline([rt]))
+    assert q == s
+
+
+# ================================================================ spill
+def test_spill_segments_bounded(tmp_path):
+    reg, clock, h = _recorder(
+        capacity=16, spill_dir=str(tmp_path / "spill"),
+        spill_segment_frames=4, spill_max_segments=3)
+    c = reg.counter("h_spill_total")
+    h.tick()
+    for i in range(10 * 16):                  # 10x the ring capacity
+        clock[0] += 1.0
+        c.inc(1)
+        h.tick()
+    assert len(h.frames()) == 16              # ring bounded
+    assert h.spill_segments <= 3              # disk bounded
+    spilled = h.spilled_frames()
+    assert 0 < len(spilled) <= 3 * 4
+    # oldest-evicted on disk too: the retained segments are the newest
+    assert spilled[-1]["seq"] == h.frames()[-1]["seq"]
+    assert spilled == sorted(spilled, key=lambda f: f["seq"])
+
+
+def test_spill_failure_disables_not_kills(tmp_path):
+    bad = tmp_path / "blocked"
+    bad.write_text("a file, not a dir")
+    reg, clock, h = _recorder(capacity=8, spill_dir=str(bad),
+                              spill_segment_frames=2,
+                              spill_max_segments=2)
+    c = reg.counter("h_sf_total")
+    h.tick()
+    for _ in range(6):
+        clock[0] += 1.0
+        c.inc(1)
+        assert h.tick() is not None           # ring keeps recording
+    assert h.meta()["spill"]["active"] is False
+
+
+# ============================================== one-delta-codepath pins
+def test_frames_equal_scrape_diff():
+    """The dhtmon pin (round-17 satellite): windowed invariants over
+    history frames equal the scrape-diff-scrape evaluation of the same
+    interval, through the SAME invariant code
+    (lookup_success/cluster_quantile over one summed series map)."""
+    from opendht_tpu.testing.telemetry_smoke import parse_exposition
+
+    reg, clock, h = _recorder(capacity=32)
+    ok = reg.counter("dht_ops_total", op="get", ok="true")
+    bad = reg.counter("dht_ops_total", op="get", ok="false")
+    hist = reg.histogram("dht_op_seconds", op="get")
+    h.tick()
+    baseline = parse_exposition(reg.prometheus())     # scrape #1
+    for i in range(5):
+        clock[0] += 1.0
+        ok.inc(9)
+        bad.inc(1)
+        hist.observe(0.25)
+        hist.observe(3.0)
+        h.tick()
+    after = parse_exposition(reg.prometheus())        # scrape #2
+    diffed = {k: max(v - baseline.get(k, 0.0), 0.0)
+              for k, v in after.items()}
+    from_frames = frames_to_series(h.frames(0.0, 5.0))
+    assert hm.lookup_success(from_frames) == hm.lookup_success(diffed)
+    assert hm.cluster_quantile(from_frames, "get", 0.95) == \
+        hm.cluster_quantile(diffed, "get", 0.95)
+
+
+def test_health_reads_through_history():
+    """Satellite: with a recorder attached the evaluator keeps NO
+    private window state, and an induced availability burn trips the
+    same latch the private-window evaluator trips on identical
+    traffic."""
+    reg = telemetry.MetricsRegistry()
+    clock = [0.0]
+    cfg = health.HealthConfig(fast_window=10.0, slow_window=30.0,
+                              min_events=4)
+    h = MetricsHistory(HistoryConfig(period=1.0, capacity=64),
+                       registry=reg, clock=lambda: clock[0])
+    ev_h = health.HealthEvaluator(cfg, registry=reg,
+                                  clock=lambda: clock[0], history=h)
+    ev_p = health.HealthEvaluator(cfg, registry=reg,
+                                  clock=lambda: clock[0])
+    ok = reg.counter("dht_ops_total", op="get", ok="true")
+    bad = reg.counter("dht_ops_total", op="get", ok="false")
+
+    def step(n_ok, n_bad):
+        clock[0] += 1.0
+        ok.inc(n_ok)
+        bad.inc(n_bad)
+        h.tick()                      # recorder ticks before health
+        return ev_h.tick(), ev_p.tick()
+
+    for _ in range(3):
+        rh, rp = step(20, 0)
+    assert rh["slo"]["get_availability"]["level"] == "healthy"
+    # history evaluator holds no private window state
+    assert all(len(st.win._h) == 0 for st in ev_h._slos)
+    for _ in range(3):
+        rh, rp = step(0, 20)          # total outage → fast burn
+    assert rh["slo"]["get_availability"]["level"] == "unhealthy"
+    assert rp["slo"]["get_availability"]["level"] == "unhealthy"
+    assert rh["verdict"] == rp["verdict"] == "unhealthy"
+    # recovery rolls the failure out of BOTH windows (slow = 30 s, so
+    # run well past it) on both paths
+    for _ in range(40):
+        rh, rp = step(20, 0)
+    assert rh["slo"]["get_availability"]["level"] == "healthy"
+    assert rp["slo"]["get_availability"]["level"] == "healthy"
+
+
+def test_health_transition_hook_and_bundle():
+    """The on_transition hook fires once per verdict change and the
+    black-box bundle built there embeds the frames that show the burn
+    — the evidence survives the incident."""
+    reg = telemetry.MetricsRegistry()
+    clock = [0.0]
+    h = MetricsHistory(HistoryConfig(period=1.0, capacity=64,
+                                     retain_bundles=2),
+                       registry=reg, clock=lambda: clock[0])
+    cfg = health.HealthConfig(fast_window=10.0, min_events=4)
+    ev = health.HealthEvaluator(cfg, registry=reg,
+                                clock=lambda: clock[0], history=h)
+    captured = []
+
+    def hook(prev, new, report):
+        if new == health.UNHEALTHY:
+            b = build_bundle(reason="health_transition", history=h,
+                             health=report)
+            b["transition"] = {"from": prev, "to": new,
+                               "causes": report["causes"]}
+            h.store_bundle(b)
+            captured.append(b)
+
+    ev.on_transition = hook
+    bad = reg.counter("dht_ops_total", op="get", ok="false")
+    for _ in range(4):
+        clock[0] += 1.0
+        bad.inc(10)
+        h.tick()
+        ev.tick()
+    assert len(captured) == 1                 # one transition, one bundle
+    b = captured[0]
+    assert b["kind"] == history.BUNDLE_KIND
+    assert b["transition"]["to"] == "unhealthy"
+    assert "get_availability" in b["transition"]["causes"]
+    # the burn is visible IN the bundle's frames (the transition fires
+    # on the first tripping tick, so at least that tick's failures are
+    # already retained)
+    burn = sum(f["counters"].get(
+        'dht_ops_total{ok="false",op="get"}', 0)
+        for f in b["history"]["frames"])
+    assert burn >= 10
+    assert h.bundles() == [b]
+    json.loads(json.dumps(b))                 # bundle is one JSON artifact
+
+
+# ============================================================ timeline
+def test_dhtmon_since_rejects_non_positive():
+    """--since 0 (or negative) must refuse loudly instead of silently
+    evaluating since-boot cumulative counters — the exact failure mode
+    --since exists to prevent (review finding).  Exit code 2 through
+    the CLI."""
+    from opendht_tpu.tools import dhtmon
+    for bad in (0.0, -5.0):
+        with pytest.raises(ValueError):
+            dhtmon.run_checks(["127.0.0.1:1"], min_success=0.99,
+                              since=bad)
+    rc = dhtmon.main(["--nodes", "127.0.0.1:1", "--min-success",
+                      "0.99", "--since", "0"])
+    assert rc == 2
+    # runners-only invocations have no GET /history: a silent skip
+    # would report a windowed gate passed when nothing was evaluated
+    with pytest.raises(ValueError):
+        dhtmon.run_checks(runners=[object()], min_success=0.99,
+                          since=60.0)
+
+
+def test_timeline_skew_and_monotonicity():
+    now = time.time()
+
+    def mkframe(seq, t, n_ok):
+        return {"seq": seq, "t": t, "mono": float(seq), "dur": 1.0,
+                "counters": {'dht_ops_total{ok="true",op="get"}': n_ok},
+                "gauges": {}, "hist": {}}
+
+    # node A scraped with a +2 s clock skew; node B clean
+    doc_a = {"node_id": "aa", "enabled": True, "time": now + 2.0,
+             "scraped_at": now,
+             "frames": [mkframe(1, now + 0.5, 5), mkframe(2, now + 1.5, 5)]}
+    doc_b = {"node_id": "bb", "enabled": True, "time": now,
+             "scraped_at": now,
+             "frames": [mkframe(1, now - 1.0, 3), mkframe(2, now, 3)]}
+    tl = ta.assemble_timeline([doc_a, doc_b])
+    assert tl["skew"]["aa"] == pytest.approx(2.0)
+    assert tl["skew"]["bb"] == pytest.approx(0.0)
+    assert not tl["violations"]
+    # skew-adjusted merge interleaves correctly: a's first frame lands
+    # at now-1.5 adjusted, before b's first at now-1.0
+    order = [(f["node"], f["seq"]) for f in tl["frames"]]
+    assert order == [("aa", 1), ("bb", 1), ("aa", 2), ("bb", 2)]
+    s = ta.window_series(tl)
+    assert s['dht_ops_total{ok="true",op="get"}'] == 16
+    # monotonicity violations are REPORTED, not dropped
+    doc_bad = {"node_id": "cc", "enabled": True,
+               "frames": [mkframe(5, now, 1), mkframe(4, now - 9, 1)]}
+    tl2 = ta.assemble_timeline([doc_bad])
+    assert len(tl2["frames"]) == 2
+    assert any("seq" in v for v in tl2["violations"])
+    assert any("before its predecessor" in v for v in tl2["violations"])
+
+
+def test_timeline_accepts_bundles_with_events():
+    reg, clock, h = _recorder()
+    c = reg.counter("h_tl_total")
+    h.tick()
+    clock[0] = 1.0
+    c.inc(2)
+    h.tick()
+    b = build_bundle(reason="on_demand", node_id="dd", history=h)
+    b["flight_recorder"]["events"] = [
+        {"ev": "health_transition", "t": time.time(),
+         "node": "dd", "attrs": {"from": "healthy", "to": "unhealthy"}}]
+    tl = ta.assemble_timeline([b])
+    assert tl["nodes"] == ["dd"]
+    assert len(tl["frames"]) == 1
+    evs = ta.find_events(tl, "health_transition")
+    assert len(evs) == 1 and evs[0]["attrs"]["to"] == "unhealthy"
+
+
+# ======================================================= live runner glue
+def test_runner_history_and_bundle_surfaces():
+    """One live node: the recorder ticks on the scheduler, GET-style
+    surfaces report frames, and dump_bundle embeds every section."""
+    from opendht_tpu.runtime.config import Config
+    from opendht_tpu.runtime.runner import DhtRunner, RunnerConfig
+    from opendht_tpu.infohash import InfoHash
+
+    cfg = Config(node_id=InfoHash.get("history-live-node"))
+    cfg.history.period = 0.05
+    cfg.health.period = 0.05
+    r = DhtRunner()
+    r.run(0, RunnerConfig(dht_config=cfg))
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len(r.get_history().get("frames", [])) >= 3:
+                break
+            time.sleep(0.05)
+        doc = r.get_history()
+        assert doc["enabled"] and len(doc["frames"]) >= 3
+        # at this short period the runner RAISES capacity so the ring
+        # still covers the health engine's time-bounded slow window
+        # (frame-count bound vs time bound — review finding)
+        import math
+        assert doc["capacity"] >= math.ceil(
+            cfg.health.slow_window * 1.25 / cfg.history.period)
+        assert "time" in doc and "mono" in doc
+        assert len(r.get_history(limit=2)["frames"]) == 2
+        # windowed filter uses the recorder clock
+        assert r.get_history(since=0.01)["frames"]
+        b = r.dump_bundle()
+        assert b["kind"] == history.BUNDLE_KIND
+        assert b["history"]["frames"]
+        assert b["node_id"] == r.get_node_id().hex()
+        for section in ("health", "metrics", "keyspace", "cache",
+                        "flight_recorder"):
+            assert section in b
+        json.loads(json.dumps(b))
+        # a lone node IS unhealthy (disconnected): the boot transition
+        # unknown -> unhealthy auto-captures a bundle — the hook fires
+        # on a live scheduler tick, not just in unit harnesses
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not r.get_bundles():
+            time.sleep(0.05)
+        bs = r.get_bundles()
+        assert bs, "boot transition captured no bundle"
+        assert bs[0]["reason"] == "health_transition"
+        assert bs[0]["transition"]["to"] == "unhealthy"
+        assert r.dump_bundle()["auto_captures"]
+    finally:
+        r.join()
+
+
+def test_runner_history_disabled():
+    from opendht_tpu.runtime.config import Config
+    from opendht_tpu.runtime.runner import DhtRunner, RunnerConfig
+    from opendht_tpu.infohash import InfoHash
+
+    cfg = Config(node_id=InfoHash.get("history-off-node"))
+    cfg.history.period = 0.0
+    r = DhtRunner()
+    r.run(0, RunnerConfig(dht_config=cfg))
+    try:
+        assert r.get_history() == {"enabled": False, "frames": []}
+        assert r.get_bundles() == []
+        b = r.dump_bundle()                   # bundles still assemble
+        assert b["history"]["enabled"] is False
+        # the health engine fell back to its private windows
+        assert r._health.evaluator.history is None
+    finally:
+        r.join()

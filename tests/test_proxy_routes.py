@@ -139,3 +139,90 @@ def test_keyspace_endpoint(topology):
     assert len(doc["hist"]) == 256
     assert "imbalance" in doc["shards"]
     assert any(t["key"] == key.hex() for t in doc["top"]), doc["top"]
+
+
+def test_trace_limit_pagination(topology):
+    """Round-17 satellite: ?limit= bounds the /trace dump (a full ring
+    dump over the proxy was unbounded); malformed limits are a 400."""
+    peer, proxy_node, server = topology
+    tr = tracing.get_tracer()
+    for i in range(8):
+        tr.event("limit_probe", n=i)
+    _code, full = _get(server, "/trace?name=limit_probe")
+    assert len(full["events"]) == 8
+    _code, lim = _get(server, "/trace?name=limit_probe&limit=3")
+    assert lim["limit"] == 3
+    # the NEWEST 3, same order as the tail of the unlimited dump
+    assert [e["seq"] for e in lim["events"]] == \
+        [e["seq"] for e in full["events"][-3:]]
+    assert len(lim["spans"]) <= 3
+    _code, zero = _get(server, "/trace?limit=0")
+    assert zero["events"] == [] and zero["spans"] == []
+    # per-trace span route paginates too
+    _code, doc = _get(server, "/trace/" + "f" * 32 + "?limit=5")
+    assert doc["spans"] == []
+    for bad in ("nan", "-1", "1.5", "x", "1_5", "%2B5"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/trace?limit=%s"
+                % (server.port, bad), timeout=20.0)
+        assert ei.value.code == 400, bad
+        assert "invalid limit" in json.loads(
+            ei.value.read().decode())["err"]
+
+
+def test_history_endpoint(topology):
+    """GET /history (round 17): the flight data recorder's frames with
+    the server clocks; since/limit filter; malformed params 400."""
+    peer, proxy_node, server = topology
+    h = proxy_node._history
+    assert h is not None
+    # drive traffic + ticks deterministically (the live cadence is 1 s)
+    key = InfoHash.get("proxy-history-key")
+    assert proxy_node.put_sync(key, Value(b"hv", value_id=71),
+                               timeout=20.0)
+    h.tick()
+    assert proxy_node.get_sync(key, timeout=20.0)
+    h.tick()
+    code, doc = _get(server, "/history")
+    assert code == 200 and doc["enabled"] is True
+    assert doc["frames"] and "time" in doc and "mono" in doc
+    assert doc["node_id"] == proxy_node.get_node_id().hex()
+    code, lim = _get(server, "/history?limit=1")
+    assert len(lim["frames"]) == 1
+    assert lim["frames"][0]["seq"] == doc["frames"][-1]["seq"]
+    code, win = _get(server, "/history?since=0.0001")
+    assert len(win["frames"]) <= len(doc["frames"])
+    # limit=0 is a valid empty page, not "unlimited" (review finding)
+    code, zero = _get(server, "/history?limit=0")
+    assert code == 200 and zero["frames"] == []
+    # NaN fails every comparison and inf is "the whole ring" dressed
+    # as a window — both malformed (review finding)
+    # Python-literal leniencies (digit-group underscores, sign
+    # prefixes, whitespace via urlencoded '+') are malformed here too
+    for bad in ("since=-1", "since=x", "since=nan", "since=inf",
+                "limit=-2", "limit=1.5", "limit=1_5", "since=1_0",
+                "limit=%2B5"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/history?%s" % (server.port, bad),
+                timeout=20.0)
+        assert ei.value.code == 400, bad
+
+
+def test_debug_bundle_endpoint(topology):
+    """GET /debug/bundle (round 17): a fresh black-box bundle over the
+    proxy — every section present, JSON round-trips."""
+    peer, proxy_node, server = topology
+    proxy_node._history.tick()
+    code, b = _get(server, "/debug/bundle")
+    assert code == 200
+    assert b["kind"] == "dht-blackbox-bundle"
+    assert b["node_id"] == proxy_node.get_node_id().hex()
+    assert b["reason"] == "on_demand"
+    for section in ("history", "flight_recorder", "health", "keyspace",
+                    "cache", "metrics", "auto_captures"):
+        assert section in b, section
+    assert b["history"]["enabled"] is True
+    assert b["history"]["frames"]
+    assert isinstance(b["flight_recorder"]["events"], list)

@@ -487,3 +487,59 @@ def test_proxy_stats_prometheus_route():
         assert "requestRate" in obj
     finally:
         srv.stop()
+
+
+# ------------------------------------------- snapshot_diff edges (round 17)
+def test_snapshot_diff_series_only_in_after():
+    """A series born between the snapshots diffs against zero — the
+    case every overhead driver hits on its first instrumented rep
+    (round-17 satellite: snapshot_diff was load-bearing for the paired
+    drivers but only exercised indirectly)."""
+    reg = telemetry.MetricsRegistry()
+    before = reg.snapshot()
+    reg.counter("sd_new_total", op="x").inc(7)
+    reg.histogram("sd_new_seconds").observe(0.25)
+    d = telemetry.snapshot_diff(before, reg.snapshot())
+    assert d["counters"]['sd_new_total{op="x"}'] == 7
+    assert d["histograms"]["sd_new_seconds"] == {"count": 1, "sum": 0.25}
+
+
+def test_snapshot_diff_bucket_set_growth():
+    """Observations landing in a bucket the ``before`` snapshot never
+    had must still produce the right count/sum delta (the diff reads
+    count/sum, never assumes matching bucket sets)."""
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("sd_grow_seconds")
+    h.observe(0.5)
+    before = reg.snapshot()
+    h.observe(1e6)          # a brand-new (far) bucket
+    h.observe(1e6)
+    d = telemetry.snapshot_diff(before, reg.snapshot())
+    got = d["histograms"]["sd_grow_seconds"]
+    assert got["count"] == 2
+    assert got["sum"] == pytest.approx(2e6)
+    # bucket sets genuinely differ between the snapshots
+    nb = len(reg.snapshot()["histograms"]["sd_grow_seconds"]["buckets"])
+    assert nb == 2
+
+
+def test_snapshot_diff_labeled_series_mismatch():
+    """Label sets that exist on only ONE side stay distinct series:
+    present-only-in-after diffs against zero, present-only-in-before
+    (a registry reset mid-run) surfaces as a NEGATIVE delta rather
+    than silently vanishing — the overhead drivers would misattribute
+    a whole mode otherwise."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("sd_lab_total", mode="a").inc(3)
+    before = reg.snapshot()
+    reg.reset()                        # zero IN PLACE (test helper)
+    reg.counter("sd_lab_total", mode="b").inc(5)
+    d = telemetry.snapshot_diff(before, reg.snapshot())
+    assert d["counters"]['sd_lab_total{mode="b"}'] == 5
+    assert d["counters"]['sd_lab_total{mode="a"}'] == -3
+    # zero-delta series are dropped entirely
+    reg2 = telemetry.MetricsRegistry()
+    reg2.counter("sd_zero_total").inc(2)
+    snap = reg2.snapshot()
+    d2 = telemetry.snapshot_diff(snap, snap)
+    assert d2 == {"counters": {}, "gauges": {}, "histograms": {}}
