@@ -363,6 +363,59 @@ def check_overhead_captures(failures):
                             f"acceptance_pct={acc:g}")
 
 
+def check_swarm_storm(failures):
+    """Round-18 rule, BOTH directions: the committed swarm-storm
+    acceptance artifact (``captures/swarm_storm.json``) must itself
+    satisfy the ISSUE-13 acceptance — a >=50k-node swarm with both
+    invariants restored (>=0.95) after healing — and README *and*
+    PARITY must each carry a ``<!-- capture:swarm_storm -->``-tagged
+    paragraph quoting the node count and the mid-cut coverage
+    collapse; a tagged claim without the artifact (or vice versa)
+    fails."""
+    cap_path = os.path.join(ROOT, "captures", "swarm_storm.json")
+    cap = None
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            cap = json.load(f)
+        if cap.get("n_nodes", 0) < 50_000:
+            failures.append(
+                "captures/swarm_storm.json: n_nodes=%r is under the "
+                "50000-node acceptance floor" % cap.get("n_nodes"))
+        for inv in ("final_lookup_success", "final_replica_coverage"):
+            if cap.get(inv, 0.0) < 0.95:
+                failures.append(
+                    f"captures/swarm_storm.json: {inv}={cap.get(inv)} — "
+                    f"invariants not restored after healing")
+    tag = "<!-- capture:swarm_storm -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if cap is None:
+            if tagged:
+                failures.append(f"{name}: '{tag}' claim with no "
+                                f"captures/swarm_storm.json artifact")
+            continue
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the swarm-storm acceptance run")
+            continue
+        want_nodes = "%d-node" % cap.get("n_nodes", 0)
+        want_cov = "%.2f" % cap.get("min_coverage_during_cut", -1.0)
+        for li in tagged:
+            para = _para_at(lines, li)
+            if want_nodes not in para:
+                failures.append(
+                    f"{name}: [capture:swarm_storm] paragraph does not "
+                    f"quote the {want_nodes} scale")
+            if want_cov not in para:
+                failures.append(
+                    f"{name}: [capture:swarm_storm] paragraph does not "
+                    f"quote the {want_cov} mid-cut coverage collapse")
+
+
 #: the observability index (ISSUE-10 satellite): every serving surface
 #: and the reference counterpart(s) it maps to.  BOTH directions: each
 #: surface must appear as a row of the tagged table in README AND
@@ -493,6 +546,7 @@ def main() -> int:
     checked = check_config_captures(failures)
     check_tp_wire(failures)
     check_overhead_captures(failures)
+    check_swarm_storm(failures)
     check_observability_index(failures)
     check_trajectory(failures)
     if failures:

@@ -340,6 +340,41 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "history overhead smoke failed"
 PY
+# adversarial chaos smoke (round 18): (1) a scripted partition+heal on
+# a small real-UDP cluster — the isolated node's gets fail, /healthz
+# degrades to 503, a black-box bundle auto-captures on the unhealthy
+# transition and dhtmon --since flags the burn window; healing rolls
+# the verdict back (healthz 200, dhtmon clean).  (2) the virtual-net
+# storm: chaos-off == baseline pinned (armed-but-empty plan delivers
+# identical results with zero drops), then per-link loss/dup/reorder +
+# an asymmetric partition phase + join/leave storm steps with per-rule
+# drop accounting and every stored key still resolvable post-heal.
+# (3) a 4096-node device swarm steps the same storm arc: invariants
+# degrade mid-partition and are restored after healing.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.chaos_smoke import main
+rc = main()
+assert rc == 0, "chaos smoke failed"
+PY
+# swarm-stepper smoke (round 18): the storm arc rerun at S=4096 through
+# benchmarks/exp_chaos_r18.py --smoke, asserting bit-for-bit
+# determinism under the fixed seed (two runs replay identically) and
+# feeding the perf gate's swarm_tick_ms timing record; the full
+# S=50000 acceptance run is committed as captures/swarm_storm.json.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_chaos_r18", pathlib.Path("benchmarks/exp_chaos_r18.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "--ticks", "22"])
+assert rc == 0, "swarm stepper smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

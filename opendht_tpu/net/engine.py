@@ -205,6 +205,13 @@ class NetworkEngine:
         # processes still assemble per-node trees.
         self._tracer = tracing.get_tracer()
         self._node_tag = str(myid)
+        # adversarial chaos plane (ISSUE-13): optional per-packet fault
+        # hook consulted by _send.  None (the default) leaves the send
+        # path byte-identical to pre-chaos builds; armed by
+        # opendht_tpu/chaos.py arm_engine under the Config.chaos_enabled
+        # guard.  hook(data, addr) -> True means the hook consumed the
+        # packet (dropped, or rescheduled with extra delay).
+        self.fault_hook: Optional[Callable[[bytes, SockAddr], bool]] = None
 
     def _count_msg(self, direction: str, mtype: str) -> None:
         c = self._m_msgs.get((direction, mtype))
@@ -259,6 +266,9 @@ class NetworkEngine:
         return span, span.ctx
 
     def _send(self, data: bytes, addr: SockAddr) -> int:
+        hook = self.fault_hook
+        if hook is not None and hook(data, addr):
+            return 0
         try:
             return self._send_fn(data, addr) or 0
         except OSError as e:

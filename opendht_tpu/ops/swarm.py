@@ -1,0 +1,719 @@
+"""Device-resident swarm stepper: tens of thousands of simulated DHT
+nodes advanced through a :class:`~opendht_tpu.chaos.FaultPlan` entirely
+on device (ISSUE-13 tentpole, ROADMAP item 5).
+
+Per-simulated-node state is batched into flat arrays — node ids
+(uint32 [S,5] limbs), liveness + last-seen, **routing-table occupancy
+limbs** (160 buckets x 4-bit counts nibble-packed into uint32 [S,20] —
+a 100k-node swarm's routing state is 8 MB resident), a parallel
+attacker-occupancy plane for eclipse/sybil phases, and the stored-key
+replica assignment (int32 [K,R] rows).  One jitted
+:func:`swarm_step` launch advances the whole swarm one tick:
+
+- **join/leave storms** — per-node uniform draws against the phase's
+  :class:`~opendht_tpu.chaos.Storm` rates;
+- **asymmetric partitions** — a [G,G] reachability matrix derived from
+  the phase's :class:`~opendht_tpu.chaos.Partition` gates every
+  maintenance/refresh/republish interaction (healing = the phase
+  ends and the matrix goes all-True);
+- **routing maintenance** — the PR-5 fused
+  :func:`~opendht_tpu.ops.radix.maintenance_sweep` is the tick kernel:
+  vmapped over a rotating sample of nodes it computes each sampled
+  node's TRUE per-bucket reachable-alive occupancy + staleness against
+  the whole population, refilling its table exactly; every other node
+  that wins its maintenance draw refreshes to the analytic steady-state
+  k-bucket fill ``min(k, reachable >> (b+1))`` (the sweep's exact
+  counts pin the analytic model each tick — ``model_err`` in the
+  returned metrics is the integer sum of their disagreement over the
+  sampled rows);
+- **eclipse/sybil poisoning** — attacker entries are admitted into at
+  most the FREE slots of each victim bucket (the reference routing
+  table's full-bucket admission rule, src/routing_table.cpp:204-262)
+  and evicted by the first successful maintenance pass after the
+  poison phase ends (3x request expiry);
+- **republish** — on calendar ticks, due keys re-resolve their
+  closest-R replica set over the currently alive+reachable population
+  (one batched XOR top-R, the same 5-limb lexicographic selection the
+  shipping ``find_closest_nodes_batched`` kernel performs).
+
+Determinism and the host oracle: the step consumes PRE-DRAWN random
+bits (uint32 arrays the driver derives from one seeded PRNG key), so
+the jitted step and the scalar-flavored numpy oracle
+:func:`swarm_step_host` consume identical entropy and are pinned
+**bit-identical** at small N (tests/test_swarm.py); a fixed seed
+replays a storm exactly.  All in-step reductions are integer/boolean
+(no float accumulation order), so equality is exact, not approximate.
+
+Probes (:func:`lookup_success_probe`, :func:`replica_coverage`) are the
+measurement half: a lookup for key ``h`` from source ``s`` succeeds
+when ``s`` is alive, its routing bucket toward ``h`` holds at least one
+live reachable honest entry (poisoned slots do not count), and at least
+one of ``h``'s true closest-R alive nodes is reachable from ``s`` — the
+structural form of the PR-9 lookup-success invariant.  The
+:class:`SwarmSim` driver publishes both as ``dht_swarm_*`` gauges and
+``swarm_verdict``/``chaos_phase`` flight events, so swarm verdicts flow
+through the same health/timeline spine as live clusters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .. import chaos, telemetry, tracing
+from ..health import DEGRADED, HEALTHY, UNHEALTHY
+from .ids import ID_BITS, N_LIMBS, ids_to_bytes
+
+K_BUCKET = 8                     # slots per bucket (TARGET_NODES)
+NIB_PER_LIMB = 8                 # 8 x 4-bit counts per uint32 limb
+OCC_LIMBS = ID_BITS // NIB_PER_LIMB      # 20 occupancy limbs per node
+REPLICAS = 8                     # stored-key replica factor
+
+_U32_MAX = 0xFFFFFFFF
+
+STATE_KEYS = ("ids", "group", "alive", "last_seen", "table_fresh",
+              "occ", "poison", "keys", "key_src", "replicas")
+
+
+# ------------------------------------------------------------- shared math
+def _unif(xp, r):
+    """uint32 -> float32 in [0, 1): top 24 bits scaled by 2^-24 — every
+    value is exactly representable, so device and host agree bit-for-bit."""
+    return (r >> xp.uint32(8)).astype(xp.float32) * xp.float32(2.0 ** -24)
+
+
+def _unpack_occ(xp, limbs):
+    """nibble-packed uint32 [..., 20] -> int32 [..., 160] counts."""
+    shifts = (xp.arange(NIB_PER_LIMB).astype(xp.uint32) * xp.uint32(4))
+    nib = (limbs[..., :, None] >> shifts) & xp.uint32(0xF)
+    return nib.reshape(limbs.shape[:-1] + (ID_BITS,)).astype(xp.int32)
+
+
+def _pack_occ(xp, counts):
+    """int32 [..., 160] counts (0..15) -> uint32 [..., 20] limbs."""
+    nib = counts.reshape(counts.shape[:-1] + (OCC_LIMBS, NIB_PER_LIMB))
+    shifts = (xp.arange(NIB_PER_LIMB).astype(xp.uint32) * xp.uint32(4))
+    return xp.sum(nib.astype(xp.uint32) << shifts, axis=-1,
+                  dtype=xp.uint32)
+
+
+def _avail(xp, rc, n_buckets=ID_BITS):
+    """Analytic steady-state k-bucket fill: bucket b of a node with
+    ``rc`` reachable alive peers holds ~``rc >> (b+1)`` of them (the
+    Kademlia prefix-partition), capped at K_BUCKET.  int32 [..., 160]."""
+    sh = xp.minimum(xp.arange(n_buckets, dtype=xp.int32) + 1, 31)
+    return xp.minimum(rc[..., None] >> sh, K_BUCKET).astype(xp.int32)
+
+
+def _closest_r(xp, keys, ids, valid, r):
+    """Rows of the ``r`` XOR-closest valid ids per key — the batched
+    closest-node selection (one 5-limb lexicographic sort per key,
+    index tiebreak so the result is unique and device==host).  Invalid
+    rows sort last; returns (sel int32 [K,r], sel_valid bool [K,r])."""
+    S = ids.shape[0]
+    valid = xp.broadcast_to(valid, (keys.shape[0], S))
+    d = xp.bitwise_xor(keys[:, None, :], ids[None, :, :])
+    dm = xp.where(valid[:, :, None], d, xp.uint32(_U32_MAX))
+    idx = xp.broadcast_to(xp.arange(S, dtype=xp.int32), dm.shape[:2])
+    order = xp.lexsort((idx, dm[..., 4], dm[..., 3], dm[..., 2],
+                        dm[..., 1], dm[..., 0]), axis=-1)
+    sel = order[:, :r].astype(xp.int32)
+    sel_valid = xp.take_along_axis(valid, sel, axis=1)
+    return sel, sel_valid
+
+
+# ================================================================ device
+def _swarm_step_impl(state, now, leave_rate, join_rate, loss,
+                     repub_rate, stale_age, reach, poison_on,
+                     poison_mask, poison_pressure, repub_on, sweep_idx,
+                     rand_node, rand_key):
+    import jax
+    import jax.numpy as jnp
+    from . import radix
+
+    xp = jnp
+    ids = state["ids"]
+    group = state["group"]
+    alive = state["alive"]
+    S = ids.shape[0]
+    G = reach.shape[0]
+
+    # -- churn: leave/join storms
+    u0 = _unif(xp, rand_node[:, 0])
+    u1 = _unif(xp, rand_node[:, 1])
+    leave = alive & (u0 < leave_rate)
+    join = (~alive) & (u1 < join_rate)
+    alive2 = (alive & ~leave) | join
+    last_seen2 = xp.where(alive2, now, state["last_seen"])
+
+    # -- partition-aware reachable population per node (integer-exact)
+    gcount = jnp.zeros((G,), jnp.int32).at[group].add(
+        alive2.astype(jnp.int32))
+    reach_i = reach.astype(jnp.int32)
+    rc_group = xp.sum(reach_i * gcount[None, :], axis=1)
+    self_reach = xp.take(xp.diagonal(reach), group)
+    rc = rc_group[group] - (alive2 & self_reach).astype(jnp.int32)
+    n_alive = xp.sum(alive2.astype(jnp.int32))
+
+    # -- maintenance draw: (1-loss) x reachable fraction
+    denom = xp.maximum(n_alive - 1, 1).astype(jnp.float32)
+    p_maint = (jnp.float32(1.0) - loss) * (rc.astype(jnp.float32) / denom)
+    ok_maint = alive2 & (_unif(xp, rand_node[:, 2]) < p_maint)
+
+    # -- the PR-5 fused maintenance sweep as the tick kernel: exact
+    # per-bucket reachable-alive occupancy + staleness for the rotating
+    # sample (valid = alive & reachable-from-me & not-me)
+    self_ids = xp.take(ids, sweep_idx, axis=0)
+    reach_rows = reach[xp.take(group, sweep_idx)]          # [M, G]
+    reach_ms = xp.take(reach_rows, group, axis=1)          # [M, S]
+    valid_m = (alive2[None, :] & reach_ms
+               & (xp.arange(S, dtype=jnp.int32)[None, :]
+                  != sweep_idx[:, None]))
+    sweep = jax.vmap(radix.maintenance_sweep,
+                     in_axes=(0, None, 0, None, None, None, None))
+    counts, _last, stale, _targets = sweep(
+        self_ids, ids, valid_m, last_seen2, now, stale_age,
+        jax.random.PRNGKey(0))
+    counts = counts.astype(jnp.int32)
+
+    # -- occupancy planes (nibble-unpacked)
+    occ_n = _unpack_occ(xp, state["occ"])
+    poi_n = _unpack_occ(xp, state["poison"])
+    victim = poison_on & poison_mask
+    # sybil admission: only the FREE slots of a bucket admit attacker
+    # entries (full-bucket rejection, src/routing_table.cpp:204-262)
+    poi2 = xp.where(victim[:, None],
+                    xp.minimum(poi_n + poison_pressure,
+                               xp.maximum(K_BUCKET - occ_n, 0)),
+                    poi_n)
+    # attacker entries expire on the first successful maintenance pass
+    # once the poison phase is over (sybils stop answering)
+    poi3 = xp.where((ok_maint & ~victim)[:, None], 0, poi2)
+    av = _avail(xp, rc)
+    target = xp.minimum(av, K_BUCKET - poi3)
+    occ2 = xp.where(ok_maint[:, None], target, occ_n)
+    # exact refill for the swept rows (counts from the fused sweep)
+    sweep_fill = xp.minimum(counts,
+                            K_BUCKET - xp.take(poi3, sweep_idx, axis=0))
+    sweep_fill = xp.where(xp.take(alive2, sweep_idx)[:, None],
+                          sweep_fill, 0)
+    occ2 = occ2.at[sweep_idx].set(sweep_fill)
+    # joiners bootstrap sparse (one known peer per non-empty bucket);
+    # dead nodes hold no table
+    occ2 = xp.where(join[:, None], xp.minimum(av, 1), occ2)
+    occ2 = xp.where(alive2[:, None], occ2, 0)
+    poi3 = xp.where(alive2[:, None] & ~join[:, None], poi3, 0)
+
+    fresh = xp.where(ok_maint | join, now, state["table_fresh"])
+    fresh = fresh.at[sweep_idx].set(
+        xp.where(xp.take(alive2, sweep_idx), now,
+                 xp.take(fresh, sweep_idx)))
+
+    # -- republish: due keys re-resolve closest-R over alive+reachable
+    replicas = state["replicas"]
+    keys = state["keys"]
+    key_src = state["key_src"]
+    R = replicas.shape[1]
+
+    def do_repub(_):
+        due = _unif(xp, rand_key) < repub_rate
+        valid_ks = (alive2[None, :]
+                    & reach[group[key_src][:, None], group[None, :]])
+        sel, sel_valid = _closest_r(xp, keys, ids, valid_ks, R)
+        newrep = xp.where(sel_valid, sel, -1)
+        return xp.where(due[:, None], newrep, replicas)
+
+    replicas2 = jax.lax.cond(repub_on, do_repub,
+                             lambda _: replicas, 0)
+
+    new_state = {
+        "ids": ids, "group": group, "alive": alive2,
+        "last_seen": last_seen2, "table_fresh": fresh,
+        "occ": _pack_occ(xp, occ2), "poison": _pack_occ(xp, poi3),
+        "keys": keys, "key_src": key_src, "replicas": replicas2,
+    }
+    # integer-only metrics (no float accumulation order): ratios are
+    # derived host-side
+    analytic_at_sweep = xp.take(target, sweep_idx, axis=0)
+    swept_alive = xp.take(alive2, sweep_idx)
+    metrics = {
+        "n_alive": n_alive,
+        "n_leave": xp.sum(leave.astype(jnp.int32)),
+        "n_join": xp.sum(join.astype(jnp.int32)),
+        "n_maint_ok": xp.sum(ok_maint.astype(jnp.int32)),
+        "occ_sum": xp.sum(occ2),
+        "poison_sum": xp.sum(poi3),
+        "stale_buckets": xp.sum(
+            xp.where(swept_alive[:, None], stale.astype(jnp.int32), 0)),
+        "model_err": xp.sum(
+            xp.where(swept_alive[:, None],
+                     xp.abs(analytic_at_sweep - sweep_fill), 0)),
+    }
+    return new_state, metrics
+
+
+_jit_cache: dict = {}
+
+
+def swarm_step(state, now, leave_rate, join_rate, loss, repub_rate,
+               stale_age, reach, poison_on, poison_mask,
+               poison_pressure, repub_on, sweep_idx, rand_node,
+               rand_key):
+    """One device launch advancing the whole swarm one tick (see module
+    docstring).  All args are arrays/scalars; random bits are
+    pre-drawn so :func:`swarm_step_host` is bit-identical."""
+    import jax
+    fn = _jit_cache.get("step")
+    if fn is None:
+        fn = _jit_cache["step"] = jax.jit(_swarm_step_impl)
+    return fn(state, now, leave_rate, join_rate, loss, repub_rate,
+              stale_age, reach, poison_on, poison_mask,
+              poison_pressure, repub_on, sweep_idx, rand_node, rand_key)
+
+
+# ================================================================== host
+def _host_buckets(ids_bits, i):
+    """Bucket index of every id relative to row ``i`` (first differing
+    bit, clipped to 159; self reads 159 but callers mask self out) —
+    the numpy mirror of radix.bucket_of."""
+    x = ids_bits ^ ids_bits[i]
+    anynz = x.any(axis=1)
+    first = np.argmax(x, axis=1)
+    cb = np.where(anynz, first, ID_BITS)
+    return np.minimum(cb, ID_BITS - 1).astype(np.int64)
+
+
+def swarm_step_host(state, now, leave_rate, join_rate, loss,
+                    repub_rate, stale_age, reach, poison_on,
+                    poison_mask, poison_pressure, repub_on, sweep_idx,
+                    rand_node, rand_key):
+    """Scalar-flavored numpy oracle, bit-identical to :func:`swarm_step`
+    on the same pre-drawn random bits (pinned at small N in
+    tests/test_swarm.py)."""
+    xp = np
+    ids = np.asarray(state["ids"], np.uint32)
+    group = np.asarray(state["group"], np.int32)
+    alive = np.asarray(state["alive"], bool)
+    S = ids.shape[0]
+    G = reach.shape[0]
+    now = np.float32(now)
+    leave_rate = np.float32(leave_rate)
+    join_rate = np.float32(join_rate)
+    loss = np.float32(loss)
+    repub_rate = np.float32(repub_rate)
+    stale_age = np.float32(stale_age)
+    reach = np.asarray(reach, bool)
+    sweep_idx = np.asarray(sweep_idx, np.int32)
+    rand_node = np.asarray(rand_node, np.uint32)
+    rand_key = np.asarray(rand_key, np.uint32)
+
+    u0 = _unif(xp, rand_node[:, 0])
+    u1 = _unif(xp, rand_node[:, 1])
+    leave = alive & (u0 < leave_rate)
+    join = (~alive) & (u1 < join_rate)
+    alive2 = (alive & ~leave) | join
+    last_seen2 = np.where(alive2, now,
+                          np.asarray(state["last_seen"], np.float32))
+
+    gcount = np.zeros((G,), np.int32)
+    np.add.at(gcount, group, alive2.astype(np.int32))
+    reach_i = reach.astype(np.int32)
+    rc_group = np.sum(reach_i * gcount[None, :], axis=1, dtype=np.int32)
+    self_reach = np.diagonal(reach)[group]
+    rc = rc_group[group] - (alive2 & self_reach).astype(np.int32)
+    n_alive = np.int32(alive2.astype(np.int32).sum())
+
+    denom = np.float32(max(int(n_alive) - 1, 1))
+    p_maint = (np.float32(1.0) - loss) * (rc.astype(np.float32) / denom)
+    ok_maint = alive2 & (_unif(xp, rand_node[:, 2]) < p_maint)
+
+    # maintenance_sweep mirror over the sample
+    ids_bits = np.unpackbits(
+        ids_to_bytes(ids).astype(np.uint8), axis=-1)        # [S, 160]
+    M = sweep_idx.shape[0]
+    counts = np.zeros((M, ID_BITS), np.int32)
+    stale = np.zeros((M, ID_BITS), bool)
+    probes = np.arange(ID_BITS)
+    for m, i in enumerate(sweep_idx):
+        valid_i = (alive2 & reach[group[i], group]
+                   & (np.arange(S) != i))
+        b = _host_buckets(ids_bits, i)
+        bm = np.where(valid_i, b, -1)
+        hit = bm[None, :] == probes[:, None]
+        counts[m] = hit.sum(axis=1)
+        vals = np.where(valid_i & (last_seen2 > 0), last_seen2,
+                        -np.inf).astype(np.float32)
+        last = np.max(np.where(hit, vals[None, :], -np.inf),
+                      axis=1).astype(np.float32)
+        stale[m] = (counts[m] > 0) & (last < now - stale_age)
+
+    occ_n = _unpack_occ(xp, np.asarray(state["occ"], np.uint32))
+    poi_n = _unpack_occ(xp, np.asarray(state["poison"], np.uint32))
+    victim = bool(poison_on) & np.asarray(poison_mask, bool)
+    poi2 = np.where(victim[:, None],
+                    np.minimum(poi_n + int(poison_pressure),
+                               np.maximum(K_BUCKET - occ_n, 0)),
+                    poi_n)
+    poi3 = np.where((ok_maint & ~victim)[:, None], 0, poi2)
+    av = _avail(xp, rc)
+    target = np.minimum(av, K_BUCKET - poi3)
+    occ2 = np.where(ok_maint[:, None], target, occ_n)
+    sweep_fill = np.minimum(counts, K_BUCKET - poi3[sweep_idx])
+    sweep_fill = np.where(alive2[sweep_idx][:, None], sweep_fill, 0)
+    occ2[sweep_idx] = sweep_fill
+    occ2 = np.where(join[:, None], np.minimum(av, 1), occ2)
+    occ2 = np.where(alive2[:, None], occ2, 0)
+    poi3 = np.where(alive2[:, None] & ~join[:, None], poi3, 0)
+
+    fresh = np.where(ok_maint | join, now,
+                     np.asarray(state["table_fresh"], np.float32))
+    fresh[sweep_idx] = np.where(alive2[sweep_idx], now,
+                                fresh[sweep_idx]).astype(np.float32)
+
+    replicas = np.asarray(state["replicas"], np.int32)
+    keys = np.asarray(state["keys"], np.uint32)
+    key_src = np.asarray(state["key_src"], np.int32)
+    R = replicas.shape[1]
+    if bool(repub_on):
+        due = _unif(xp, rand_key) < repub_rate
+        valid_ks = (alive2[None, :]
+                    & reach[group[key_src][:, None], group[None, :]])
+        sel, sel_valid = _closest_r(xp, keys, ids, valid_ks, R)
+        newrep = np.where(sel_valid, sel, -1).astype(np.int32)
+        replicas2 = np.where(due[:, None], newrep, replicas)
+    else:
+        replicas2 = replicas
+
+    new_state = {
+        "ids": ids, "group": group, "alive": alive2,
+        "last_seen": last_seen2.astype(np.float32),
+        "table_fresh": fresh.astype(np.float32),
+        "occ": _pack_occ(xp, occ2), "poison": _pack_occ(xp, poi3),
+        "keys": keys, "key_src": key_src,
+        "replicas": replicas2.astype(np.int32),
+    }
+    analytic_at_sweep = target[sweep_idx]
+    swept_alive = alive2[sweep_idx]
+    metrics = {
+        "n_alive": int(n_alive),
+        "n_leave": int(leave.sum()),
+        "n_join": int(join.sum()),
+        "n_maint_ok": int(ok_maint.sum()),
+        "occ_sum": int(occ2.sum()),
+        "poison_sum": int(poi3.sum()),
+        "stale_buckets": int(
+            np.where(swept_alive[:, None], stale.astype(np.int32),
+                     0).sum()),
+        "model_err": int(
+            np.where(swept_alive[:, None],
+                     np.abs(analytic_at_sweep - sweep_fill), 0).sum()),
+    }
+    return new_state, metrics
+
+
+# ================================================================ probes
+def _lookup_probe_impl(ids, group, alive, occ, reach, probe_keys, src,
+                       replicas):
+    import jax.numpy as jnp
+    from .ids import common_bits
+
+    xp = jnp
+    S = ids.shape[0]
+    g_src = xp.take(group, src)
+    # a lookup finds the value iff some ASSIGNED replica of the key is
+    # alive and reachable from the source's side of any partition
+    rep = xp.clip(replicas, 0, S - 1)
+    rep_ok = (replicas >= 0) & xp.take(alive, rep)
+    any_rep = xp.any(rep_ok & reach[g_src[:, None], xp.take(group, rep)],
+                     axis=1)
+
+    src_ids = xp.take(ids, src, axis=0)
+    b = xp.minimum(common_bits(src_ids, probe_keys), ID_BITS - 1)
+    cb_all = common_bits(src_ids[:, None, :], ids[None, :, :])
+    bucket_all = xp.minimum(cb_all, ID_BITS - 1)
+    inb = ((bucket_all == b[:, None]) & alive[None, :]
+           & reach[g_src[:, None], group[None, :]]
+           & (xp.arange(S, dtype=jnp.int32)[None, :] != src[:, None]))
+    live_b = xp.sum(inb.astype(jnp.int32), axis=1)
+    occ_n = _unpack_occ(xp, xp.take(occ, src, axis=0))
+    occ_b = xp.take_along_axis(occ_n, b[:, None], axis=1)[:, 0]
+    eff = xp.minimum(occ_b, live_b)
+    total_occ = xp.sum(occ_n, axis=1)
+    routing_ok = xp.where(live_b > 0, eff > 0, total_occ > 0)
+    return xp.take(alive, src) & routing_ok & any_rep
+
+
+def lookup_success_probe(state, reach, probe_keys, src, replicas):
+    """Batched structural lookup-success probe (see module docstring).
+    Returns bool [P]; one launch for the whole probe set — the swarm
+    analogue of the PR-9 batched replica-coverage probe's one
+    ``find_closest`` launch."""
+    import jax
+    fn = _jit_cache.get("probe")
+    if fn is None:
+        fn = _jit_cache["probe"] = jax.jit(_lookup_probe_impl)
+    return fn(state["ids"], state["group"], state["alive"],
+              state["occ"], reach, probe_keys, src, replicas)
+
+
+def lookup_success_probe_host(state, reach, probe_keys, src, replicas):
+    """numpy mirror of :func:`lookup_success_probe` (oracle pin)."""
+    ids = np.asarray(state["ids"], np.uint32)
+    group = np.asarray(state["group"], np.int32)
+    alive = np.asarray(state["alive"], bool)
+    reach = np.asarray(reach, bool)
+    probe_keys = np.asarray(probe_keys, np.uint32)
+    src = np.asarray(src, np.int32)
+    replicas = np.asarray(replicas, np.int32)
+    S = ids.shape[0]
+    g_src = group[src]
+    rep = np.clip(replicas, 0, S - 1)
+    rep_ok = (replicas >= 0) & alive[rep]
+    any_rep = np.any(rep_ok & reach[g_src[:, None], group[rep]], axis=1)
+
+    ids_bits = np.unpackbits(ids_to_bytes(ids).astype(np.uint8), axis=-1)
+    key_bits = np.unpackbits(ids_to_bytes(probe_keys).astype(np.uint8),
+                             axis=-1)
+    out = np.zeros((len(src),), bool)
+    for p, s in enumerate(src):
+        xk = ids_bits[s] ^ key_bits[p]
+        b = min(int(np.argmax(xk)) if xk.any() else ID_BITS,
+                ID_BITS - 1)
+        buckets = _host_buckets(ids_bits, s)
+        inb = ((buckets == b) & alive & reach[g_src[p], group]
+               & (np.arange(S) != s))
+        live_b = int(inb.sum())
+        occ_n = _unpack_occ(np, np.asarray(state["occ"], np.uint32)[s])
+        eff = min(int(occ_n[b]), live_b)
+        routing_ok = (eff > 0) if live_b > 0 else (int(occ_n.sum()) > 0)
+        out[p] = bool(alive[s]) and routing_ok and bool(any_rep[p])
+    return out
+
+
+def replica_coverage(state):
+    """Per-key fraction of the key's TRUE closest-R alive nodes that
+    are in its current replica assignment — the PR-9 replica-coverage
+    invariant's structural form (the probe there cross-checks the true
+    closest-8 against the live stores).  A partition skews assignments
+    to one side, so coverage drops the moment the network heals and
+    the true closest set is global again; republish restores it.
+    float [K] in [0, 1]; integer set work only."""
+    rep = np.asarray(state["replicas"], np.int32)
+    alive = np.asarray(state["alive"], bool)
+    ids = np.asarray(state["ids"], np.uint32)
+    keys = np.asarray(state["keys"], np.uint32)
+    sel, sel_valid = _closest_r(np, keys, ids, alive, rep.shape[1])
+    hit = (sel[:, :, None] == rep[:, None, :]).any(axis=2) & sel_valid
+    denom = np.maximum(sel_valid.sum(axis=1), 1)
+    return hit.sum(axis=1) / denom
+
+
+# ================================================================ driver
+def init_swarm(seed: int, n_nodes: int, n_keys: int = 64, *,
+               replicas: int = REPLICAS, n_groups: int = 2) -> Dict:
+    """Build a converged swarm (host arrays; move to device with
+    jnp.asarray via :class:`SwarmSim`).  Groups are balanced index
+    ranges ``g0..g{G-1}`` — the names :class:`~opendht_tpu.chaos.
+    Partition`/:class:`~opendht_tpu.chaos.Poison` phases refer to."""
+    import jax
+
+    kid, kkey = jax.random.split(jax.random.PRNGKey(seed))
+    ids = np.asarray(jax.random.bits(kid, (n_nodes, N_LIMBS), np.uint32))
+    keys = np.asarray(jax.random.bits(kkey, (n_keys, N_LIMBS), np.uint32))
+    group = ((np.arange(n_nodes, dtype=np.int64) * n_groups)
+             // n_nodes).astype(np.int32)
+    alive = np.ones((n_nodes,), bool)
+    rc = np.full((n_nodes,), n_nodes - 1, np.int32)
+    occ = _pack_occ(np, _avail(np, rc))
+    key_src = (np.arange(n_keys, dtype=np.int64) % n_nodes).astype(np.int32)
+    state = {
+        "ids": ids, "group": group, "alive": alive,
+        "last_seen": np.zeros((n_nodes,), np.float32),
+        "table_fresh": np.zeros((n_nodes,), np.float32),
+        "occ": occ,
+        "poison": np.zeros((n_nodes, OCC_LIMBS), np.uint32),
+        "keys": keys, "key_src": key_src,
+        "replicas": np.full((n_keys, replicas), -1, np.int32),
+    }
+    # initial replica assignment: closest-R over the full population
+    sel, sel_valid = _closest_r(np, keys, ids, alive, replicas)
+    state["replicas"] = np.where(sel_valid, sel, -1).astype(np.int32)
+    return state
+
+
+def params_at(plan: chaos.FaultPlan, rel: float, n_groups: int,
+              group: np.ndarray) -> Dict:
+    """Fold the plan's phases active at relative time ``rel`` into the
+    stepper's tick parameters: storm rates, wildcard loss, the [G,G]
+    reachability matrix (partitions reference groups ``g0..``;
+    healing = the phase window ends), and the poison mask/pressure."""
+    storm = plan.storm_at(rel) or chaos.Storm()
+    loss = 0.0
+    for ph in plan.phases_at(rel):
+        for rule in ph.rules:
+            if rule.src == chaos.ANY and rule.dst == chaos.ANY:
+                loss = 1.0 - (1.0 - loss) * (1.0 - rule.loss)
+    names = ["g%d" % i for i in range(n_groups)]
+    reach = np.ones((n_groups, n_groups), bool)
+    for _pname, part in plan.partitions_at(rel):
+        for i, a in enumerate(names):
+            for j, b in enumerate(names):
+                if part.blocks(a, b):
+                    reach[i, j] = False
+    poison = plan.poison_at(rel)
+    if poison is not None and poison.victim in names:
+        vidx = names.index(poison.victim)
+        poison_mask = np.asarray(group) == vidx
+        poison_on = True
+        pressure = int(poison.per_bucket)
+    else:
+        poison_mask = np.zeros((len(group),), bool)
+        poison_on = False
+        pressure = 0
+    return {
+        "leave_rate": np.float32(storm.leave_rate),
+        "join_rate": np.float32(storm.join_rate),
+        "loss": np.float32(loss),
+        "reach": reach,
+        "poison_on": bool(poison_on),
+        "poison_mask": poison_mask,
+        "poison_pressure": np.int32(pressure),
+    }
+
+
+class SwarmSim:
+    """Host driver: advances a device-resident swarm through a
+    FaultPlan, one :func:`swarm_step` launch per tick, publishing
+    ``dht_swarm_*`` gauges and ``chaos_phase``/``swarm_verdict`` flight
+    events on the PR-3/PR-9 spine so swarm verdicts ride the same
+    health-invariant and timeline machinery as live clusters."""
+
+    def __init__(self, plan: chaos.FaultPlan, *, n_nodes: int,
+                 n_keys: int = 64, n_groups: int = 2, seed: int = 7,
+                 tick_dt: float = 1.0, sweep_sample: int = 32,
+                 repub_every: int = 4, repub_rate: float = 1.0,
+                 stale_age: float = 5.0, device: bool = True):
+        import jax
+        self.plan = plan
+        self.n_groups = n_groups
+        self.tick_dt = tick_dt
+        self.sweep_sample = min(sweep_sample, n_nodes)
+        self.repub_every = repub_every
+        self.repub_rate = repub_rate
+        self.stale_age = stale_age
+        self.device = device
+        self.t = 0.0
+        self.tick_no = 0
+        self._key = jax.random.PRNGKey(seed)
+        host = init_swarm(seed, n_nodes, n_keys, n_groups=n_groups)
+        self._group_host = host["group"]
+        if device:
+            import jax.numpy as jnp
+            self.state = {k: jnp.asarray(v) for k, v in host.items()}
+        else:
+            self.state = host
+        self._verdict = HEALTHY
+        self._phase_names: tuple = ()
+        reg = telemetry.get_registry()
+        self._g = {name: reg.gauge("dht_swarm_" + name)
+                   for name in ("alive", "lookup_success",
+                                "replica_coverage", "poison_occupancy",
+                                "model_err")}
+        self._tracer = tracing.get_tracer()
+
+    # -- one stepper launch per tick --------------------------------------
+    def tick(self) -> Dict:
+        import jax
+        import jax.numpy as jnp
+        rel = self.t
+        p = params_at(self.plan, rel, self.n_groups, self._group_host)
+        self._note_phases(rel)
+        self._key, k1, k2 = jax.random.split(self._key, 3)
+        S = self._group_host.shape[0]
+        K = np.asarray(self.state["keys"]).shape[0]
+        rand_node = jax.random.bits(k1, (S, 3), jnp.uint32)
+        rand_key = jax.random.bits(k2, (K,), jnp.uint32)
+        M = self.sweep_sample
+        sweep_idx = ((np.arange(M, dtype=np.int64) + self.tick_no * M)
+                     % S).astype(np.int32)
+        repub_on = (self.tick_no % self.repub_every) == 0
+        now = np.float32(rel + self.tick_dt)
+        step = swarm_step if self.device else swarm_step_host
+        rn = rand_node if self.device else np.asarray(rand_node)
+        rk = rand_key if self.device else np.asarray(rand_key)
+        self.state, metrics = step(
+            self.state, now, p["leave_rate"], p["join_rate"], p["loss"],
+            np.float32(self.repub_rate), np.float32(self.stale_age),
+            p["reach"], p["poison_on"], p["poison_mask"],
+            p["poison_pressure"], repub_on, sweep_idx, rn, rk)
+        self.t += self.tick_dt
+        self.tick_no += 1
+        metrics = {k: int(v) for k, v in metrics.items()}
+        self._g["alive"].set(metrics["n_alive"])
+        self._g["poison_occupancy"].set(metrics["poison_sum"])
+        self._g["model_err"].set(metrics["model_err"])
+        return metrics
+
+    def _note_phases(self, rel: float) -> None:
+        names = tuple(ph.name for ph in self.plan.phases_at(rel))
+        if names != self._phase_names:
+            if self._tracer.enabled:
+                self._tracer.event("chaos_phase", active=",".join(names)
+                                   or "(none)", t=rel)
+            self._phase_names = names
+
+    # -- invariants --------------------------------------------------------
+    def probe(self, n_probes: int = 32) -> Dict:
+        """Lookup-success + replica-coverage invariants at the current
+        tick, rolled into a healthy|degraded|unhealthy verdict (the
+        PR-9 thresholds: unhealthy < 0.5, degraded < 0.9)."""
+        import jax.numpy as jnp
+        keys = np.asarray(self.state["keys"])
+        P = min(n_probes, keys.shape[0])
+        probe_keys = keys[:P]
+        rep = np.asarray(self.state["replicas"])[:P]
+        # lookups originate at ALIVE nodes (a dead source is not a
+        # failed lookup, it is no lookup) — deterministic stride sample
+        live = np.nonzero(np.asarray(self.state["alive"]))[0]
+        if len(live) == 0:
+            return {"lookup_success": 0.0, "replica_coverage": 0.0,
+                    "verdict": UNHEALTHY}
+        src = live[((np.arange(P, dtype=np.int64) * 997 + self.tick_no)
+                    % len(live))].astype(np.int32)
+        rel = self.t
+        p = params_at(self.plan, rel, self.n_groups, self._group_host)
+        if self.device:
+            ok = np.asarray(lookup_success_probe(
+                self.state, jnp.asarray(p["reach"]),
+                jnp.asarray(probe_keys), jnp.asarray(src),
+                jnp.asarray(rep)))
+        else:
+            ok = lookup_success_probe_host(self.state, p["reach"],
+                                           probe_keys, src, rep)
+        cov = replica_coverage(self.state)
+        success = float(ok.sum()) / max(len(ok), 1)
+        coverage = float(cov.mean()) if len(cov) else 1.0
+        worst = min(success, coverage)
+        verdict = (UNHEALTHY if worst < 0.5
+                   else DEGRADED if worst < 0.9 else HEALTHY)
+        self._g["lookup_success"].set(success)
+        self._g["replica_coverage"].set(coverage)
+        if verdict != self._verdict:
+            if self._tracer.enabled:
+                self._tracer.event("swarm_verdict", to=verdict,
+                                   frm=self._verdict,
+                                   lookup_success=round(success, 4),
+                                   coverage=round(coverage, 4))
+            self._verdict = verdict
+        return {"lookup_success": success, "replica_coverage": coverage,
+                "verdict": verdict}
+
+    def run(self, ticks: int, *, probe_every: int = 1) -> list:
+        out = []
+        for i in range(ticks):
+            m = self.tick()
+            if probe_every and (i % probe_every) == 0:
+                m.update(self.probe())
+            out.append(m)
+        return out

@@ -242,6 +242,33 @@ def _spec_cache_probe():
             {"Q": _CANON["INGEST_Q"], "C": CACHE_CAPACITY})
 
 
+def _spec_swarm_step():
+    """The chaos swarm stepper's one-launch-per-tick device program
+    (round 18, ops/swarm.py): churn draws + partition-aware analytic
+    occupancy refresh + the vmapped PR-5 maintenance_sweep over the
+    rotating sample + poison admission/decay + the closest-R republish
+    re-resolve, at the canonical S=4096-node / M=16-sample / K=32-key
+    shape — budgeted from day one so the robustness workload
+    generator's only hot launch can't silently fatten (the ISSUE-13
+    cost-gate requirement)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from .ops import swarm
+    S, M, K, G = 4096, 16, 32, 2
+    state = {k: jnp.asarray(v)
+             for k, v in swarm.init_swarm(33, S, K, n_groups=G).items()}
+    args = (state, np.float32(1.0), np.float32(0.05), np.float32(0.05),
+            np.float32(0.1), np.float32(1.0), np.float32(5.0),
+            jnp.ones((G, G), bool), True,
+            jnp.zeros((S,), bool), np.int32(4), True,
+            jnp.arange(M, dtype=jnp.int32),
+            jax.random.bits(jax.random.PRNGKey(34), (S, 3), jnp.uint32),
+            jax.random.bits(jax.random.PRNGKey(35), (K,), jnp.uint32))
+    return (jax.jit(swarm._swarm_step_impl), args, {},
+            {"S": S, "M": M, "K": K, "G": G})
+
+
 def _spec_expanded_topk():
     """The window kernel alone (headline bench core, fast3 select)."""
     from .ops.sorted_table import expanded_topk
@@ -436,6 +463,7 @@ KERNEL_SPECS = {
     "wave_builder_lookup": (_spec_wave_builder, "dht_ingest_wave_seconds"),
     "sketch_update": (_spec_sketch_update, None),
     "cache_probe": (_spec_cache_probe, None),
+    "swarm_step": (_spec_swarm_step, None),
     "expanded_topk": (_spec_expanded_topk, None),
     "fused_gather_planar": (_spec_fused_gather, None),
     "packed_churn_merge": (_spec_packed_merge, None),

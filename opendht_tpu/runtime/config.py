@@ -152,6 +152,16 @@ class Config:
     #: and widening off — results are pinned identical either way.
     cache: HotCacheConfig = field(default_factory=HotCacheConfig)
 
+    # --- adversarial chaos plane (round 18, opendht_tpu/chaos.py) -----
+    #: allow a FaultPlan to be armed on this node's live engine send
+    #: path (``chaos.arm_dht``).  Off by default: with no plan armed
+    #: the engine's fault hook is None and the send path is
+    #: byte-identical to pre-chaos builds (pinned in
+    #: tests/test_chaos.py).  Test harnesses that own their nodes
+    #: (testing/network.py, testing/virtual_net.py) arm with
+    #: ``force=True`` instead of flipping this.
+    chaos_enabled: bool = False
+
 
 @dataclass
 class SecureDhtConfig:

@@ -8,8 +8,9 @@ from __future__ import annotations
 
 import random
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from .. import chaos
 from ..core.value import Value
 from ..infohash import InfoHash
 from ..runtime.config import Config, NodeStatus
@@ -26,20 +27,39 @@ class DhtNetwork:
         self.rng = random.Random(seed)
         self.nodes: List[DhtRunner] = []
         self.bootstrap_addr = None
+        self.injector: Optional["chaos.FaultInjector"] = None
+        self._default_group: Optional[str] = None
         for _ in range(num_nodes):
             self.launch_node()
 
     # ------------------------------------------------------------- topology
-    def launch_node(self) -> DhtRunner:
-        """(↔ DhtNetwork.launch_node, network.py:341-360)"""
+    def launch_node(self, group: Optional[str] = None) -> DhtRunner:
+        """(↔ DhtNetwork.launch_node, network.py:341-360).  While a
+        FaultPlan is armed, the fresh node's engine is hooked too so a
+        partition cannot silently leak through churn replacements; it
+        joins ``group`` (or the arm-time ``default_group``, else the
+        wildcard group)."""
         r = DhtRunner()
         r.run(0, RunnerConfig(dht_config=self.config))
+        # hook BEFORE bootstrap: the loop thread must not get a first
+        # packet out ahead of the fault hook (a replacement node in a
+        # blocked group could otherwise leak one datagram across an
+        # armed partition)
+        if self.injector is not None:
+            self._arm_one(r, group if group is not None
+                          else self._default_group)
         if self.bootstrap_addr is None:
             self.bootstrap_addr = ("127.0.0.1", r.get_bound_port())
         else:
             r.bootstrap(*self.bootstrap_addr)
         self.nodes.append(r)
         return r
+
+    def _arm_one(self, r: DhtRunner, group: Optional[str]) -> None:
+        key = ("127.0.0.1", r.get_bound_port())
+        if group is not None:
+            self.injector.plan.membership.setdefault(key, group)
+        chaos.arm_engine(r._dht._dht.engine, self.injector, key)
 
     def shutdown_node(self, node: Optional[DhtRunner] = None) -> None:
         """Stop one node (random non-seed by default)
@@ -61,9 +81,44 @@ class DhtNetwork:
         return [self.launch_node() for _ in victims]
 
     def shutdown(self) -> None:
+        self.disarm()
         for r in self.nodes:
             r.join()
         self.nodes.clear()
+
+    # --------------------------------------------------------- chaos plane
+    def arm(self, plan: "chaos.FaultPlan",
+            groups: Optional[Dict[int, str]] = None,
+            default_group: Optional[str] = None
+            ) -> "chaos.FaultInjector":
+        """Arm a FaultPlan across the live cluster (ISSUE-13): one
+        shared injector, per-node fault hooks on every engine's send
+        path — the same seam the virtual net and the live engine use.
+        ``groups`` maps node INDEX → plan group; membership is derived
+        from each runner's bound port so link rules and partitions
+        match real datagrams.  An asymmetric partition is enforced at
+        the SENDER (each direction's source drops), exactly netem's
+        egress qdisc semantics.  Nodes launched later (churn
+        replacements) are hooked automatically and join
+        ``default_group`` (wildcard when None)."""
+        groups = groups or {}
+        self._default_group = default_group
+        self.injector = chaos.FaultInjector(plan)
+        self.injector.arm(time.monotonic())
+        for i, r in enumerate(self.nodes):
+            self._arm_one(r, groups.get(i, default_group))
+        return self.injector
+
+    def disarm(self) -> None:
+        if getattr(self, "injector", None) is None:
+            return
+        for r in self.nodes:
+            try:
+                chaos.disarm_dht(r._dht._dht)
+            except Exception:
+                pass
+        self.injector = None
+        self._default_group = None
 
     # ------------------------------------------------------------- plumbing
     def wait_connected(self, timeout: float = 30.0) -> bool:

@@ -18,15 +18,21 @@ import itertools
 import random
 from typing import Callable, Dict, List, Optional
 
+from opendht_tpu.chaos import FaultInjector, FaultPlan, LinkRule, Phase
 from opendht_tpu.runtime import Config, Dht
 from opendht_tpu.scheduler import Scheduler
 from opendht_tpu.sockaddr import SockAddr
 from opendht_tpu.utils import TIME_MAX
 
+#: held-back duplicate copies arrive this far after the original so the
+#: two deliveries are distinct events, like real dup-on-retransmit
+DUP_GAP = 1e-4
+
 
 class VirtualNet:
     def __init__(self, *, delay: float = 0.01, jitter: float = 0.0,
-                 loss: float = 0.0, seed: int = 42):
+                 loss: float = 0.0, seed: int = 42,
+                 plan: Optional[FaultPlan] = None):
         self.clock = 0.0
         self.delay = delay
         self.jitter = jitter
@@ -37,12 +43,65 @@ class VirtualNet:
         self._seq = itertools.count()
         self._next_port = 20000
         self.dropped = 0
+        #: drop accounting split per netem rule: the legacy uniform
+        #: loss counts under "uniform"; FaultPlan rules/partitions
+        #: under their own names (ISSUE-13 satellite)
+        self.dropped_by_rule: Dict[str, int] = {}
+        # adversarial chaos plane (ISSUE-13): an armed FaultInjector is
+        # consulted on every send BEFORE the uniform netem — per-link
+        # asymmetric loss/dup/reorder/partitions ride the one seam the
+        # real-UDP harness and the live engine share.
+        self.injector: Optional[FaultInjector] = None
+        if plan is not None:
+            self.arm(plan)
         # lazy min-heap over per-node next-job times: O(log N) per event
         # instead of scanning every scheduler per event (the O(N·events)
         # scan capped clusters at a few hundred nodes; hop-parity needs
         # 2K-8K).  Entries (t, key) are stale unless _ntimes[key] == t.
         self._ntimes: Dict[tuple, float] = {}
         self._sheap: list = []
+
+    # --------------------------------------------------------- chaos plane
+    def arm(self, plan: FaultPlan) -> FaultInjector:
+        """Arm a FaultPlan at the current virtual time; phase windows
+        are relative to now.  Partitions heal when their phase ends."""
+        self.injector = FaultInjector(plan)
+        self.injector.arm(self.clock)
+        return self.injector
+
+    def disarm(self) -> None:
+        self.injector = None
+
+    def add_link_rule(self, rule: LinkRule,
+                      membership: Optional[Dict[tuple, str]] = None) -> None:
+        """Static (always-on) per-link netem without writing a plan —
+        the -l/-d uniform knobs generalized to asymmetric per-link
+        loss/dup/reorder/delay."""
+        if self.injector is None:
+            self.arm(FaultPlan([Phase("netem")], membership=membership))
+        elif membership:
+            self.injector.plan.membership.update(membership)
+        for ph in self.injector.plan.phases:
+            if ph.name == "netem" and ph.duration is None:
+                ph.rules.append(rule)
+                return
+        self.injector.plan.phases.append(Phase("netem", rules=[rule]))
+
+    def set_group(self, dht: Dht, group: str) -> None:
+        """Assign a node to a plan group (partitions/link rules match
+        on groups)."""
+        if self.injector is None:
+            self.arm(FaultPlan([]))
+        key = (dht.bound_addr.host, dht.bound_addr.port)
+        self.injector.plan.membership[key] = group
+
+    def _drop(self, rule: str) -> None:
+        self.dropped += 1
+        self.dropped_by_rule[rule] = self.dropped_by_rule.get(rule, 0) + 1
+
+    def _enqueue(self, arrival: float, data: bytes, src, dst_key) -> None:
+        heapq.heappush(self._queue,
+                       (arrival, next(self._seq), data, src, dst_key))
 
     # ------------------------------------------------------------- topology
     def add_node(self, config: Optional[Config] = None,
@@ -54,13 +113,29 @@ class VirtualNet:
         key = (addr.host, addr.port)
 
         def send_fn(data: bytes, dest: SockAddr, _src=addr) -> int:
-            if self.loss and self.rng.random() < self.loss:
-                self.dropped += 1
-                return 0
-            arrival = self.clock + self.delay + \
+            src_key = (_src.host, _src.port)
+            dst_key = (dest.host, dest.port)
+            extra = 0.0
+            copies = 1
+            inj = self.injector
+            if inj is not None and inj.armed:
+                fate = inj.fate(src_key, dst_key, self.clock)
+                if fate.drop:
+                    self._drop(fate.rule or "chaos")
+                    return 0
+                extra = fate.delay
+                copies += fate.dup
+            arrival = self.clock + self.delay + extra + \
                 (self.rng.random() * self.jitter if self.jitter else 0.0)
-            heapq.heappush(self._queue, (arrival, next(self._seq), data,
-                                         _src, (dest.host, dest.port)))
+            # netem order: duplication happens in the network, then
+            # each copy is independently subject to the uniform loss;
+            # copies trail THEIR original's (jittered) arrival so a
+            # dup can never overtake it
+            for i in range(copies):
+                if self.loss and self.rng.random() < self.loss:
+                    self._drop("uniform")
+                    continue
+                self._enqueue(arrival + i * DUP_GAP, data, _src, dst_key)
             return 0
 
         dht = Dht(send_fn, config, Scheduler(clock=lambda: self.clock),
@@ -101,6 +176,26 @@ class VirtualNet:
             self.bootstrap_node(d, seed_node)
             fresh.append(d)
         return fresh
+
+    def step_storm(self, storm, seed_node: Dht,
+                   config: Optional[Config] = None) -> tuple:
+        """Apply one join/leave storm step from a :class:`~opendht_tpu.
+        chaos.Storm`: every non-seed node leaves with ``leave_rate``,
+        and ``join_rate`` × current-size fresh nodes bootstrap at the
+        seed.  Returns (left, joined) counts; deterministic under the
+        net's seed."""
+        victims = [d for d in list(self.nodes.values())
+                   if d is not seed_node
+                   and self.rng.random() < storm.leave_rate]
+        for v in victims:
+            self.remove_node(v)
+        joins = 0
+        target = int(storm.join_rate * max(len(self.nodes), 1))
+        for _ in range(target):
+            d = self.add_node(config)
+            self.bootstrap_node(d, seed_node)
+            joins += 1
+        return len(victims), joins
 
     def storers_of(self, key) -> List[Dht]:
         """Nodes currently holding values for ``key`` locally."""

@@ -1,0 +1,201 @@
+"""Device-resident swarm stepper tests (ISSUE-13 tentpole): the jitted
+:func:`opendht_tpu.ops.swarm.swarm_step` pinned BIT-IDENTICAL to the
+scalar-flavored numpy oracle across a full multi-phase FaultPlan,
+determinism under a fixed seed, the admission-bounded poison plane,
+closest-R parity with the shipping XOR top-k kernel, and the
+storm → partition → heal invariant arc (lookup-success and
+replica-coverage restored after healing)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu import chaos
+from opendht_tpu.health import DEGRADED, HEALTHY, UNHEALTHY
+from opendht_tpu.ops import swarm
+
+pytestmark = pytest.mark.quick
+
+
+def full_plan(seed=3):
+    """Every phase kind: storm, recovery, asymmetric partition, poison."""
+    return chaos.FaultPlan([
+        chaos.Phase("storm", start=1.0, duration=3.0,
+                    storm=chaos.Storm(leave_rate=0.2, join_rate=0.1)),
+        chaos.Phase("lossy", start=1.0, duration=6.0,
+                    rules=[chaos.LinkRule(name="wan", loss=0.2)]),
+        chaos.Phase("split", start=5.0, duration=4.0,
+                    partition=chaos.Partition(block=[("g0", "g1")])),
+        chaos.Phase("poison", start=9.0, duration=3.0,
+                    poison=chaos.Poison(victim="g1", per_bucket=8)),
+        chaos.Phase("recover", start=12.0, duration=3.0,
+                    storm=chaos.Storm(join_rate=0.5)),
+    ], seed=seed)
+
+
+# ------------------------------------------------------------ oracle pins
+def test_step_bit_identical_to_host_oracle():
+    """Device stepper == numpy oracle on every state array, metric and
+    probe, through 16 ticks spanning every phase kind."""
+    kw = dict(n_nodes=48, n_keys=8, n_groups=2, seed=5, sweep_sample=8)
+    dev = swarm.SwarmSim(full_plan(), device=True, **kw)
+    host = swarm.SwarmSim(full_plan(), device=False, **kw)
+    for t in range(16):
+        md, mh = dev.tick(), host.tick()
+        assert md == {k: int(v) for k, v in mh.items()}, (t, md, mh)
+        for k in swarm.STATE_KEYS:
+            a, b = np.asarray(dev.state[k]), np.asarray(host.state[k])
+            assert np.array_equal(a, b), (t, k)
+        assert dev.probe() == host.probe(), t
+
+
+def test_deterministic_under_seed():
+    kw = dict(n_nodes=64, n_keys=8, n_groups=2, sweep_sample=8)
+    a = swarm.SwarmSim(full_plan(), seed=11, **kw)
+    b = swarm.SwarmSim(full_plan(), seed=11, **kw)
+    c = swarm.SwarmSim(full_plan(), seed=12, **kw)
+    ma, mb, mc = a.run(10), b.run(10), c.run(10)
+    assert ma == mb
+    assert ma != mc
+    for k in swarm.STATE_KEYS:
+        assert np.array_equal(np.asarray(a.state[k]),
+                              np.asarray(b.state[k])), k
+
+
+def test_occupancy_limbs_roundtrip():
+    rng = np.random.default_rng(0)
+    counts = rng.integers(0, 9, size=(17, swarm.ID_BITS)).astype(np.int32)
+    packed = swarm._pack_occ(np, counts)
+    assert packed.shape == (17, swarm.OCC_LIMBS)
+    assert np.array_equal(swarm._unpack_occ(np, packed), counts)
+    # device path agrees
+    jpacked = swarm._pack_occ(jnp, jnp.asarray(counts))
+    assert np.array_equal(np.asarray(jpacked), packed)
+    assert np.array_equal(
+        np.asarray(swarm._unpack_occ(jnp, jpacked)), counts)
+
+
+def test_closest_r_matches_shipping_xor_topk_distances():
+    """The stepper's 5-limb lexicographic closest-R selection returns
+    the SAME distance set as the shipping ops/xor_topk kernel (index
+    ties may order differently; the XOR distances must agree)."""
+    from opendht_tpu.ops.xor_topk import xor_topk
+
+    key = jax.random.PRNGKey(2)
+    ids = jax.random.bits(key, (256, 5), jnp.uint32)
+    queries = jax.random.bits(jax.random.PRNGKey(3), (7, 5), jnp.uint32)
+    valid = np.ones((256,), bool)
+    valid[::5] = False
+    sel, sel_valid = swarm._closest_r(
+        np, np.asarray(queries), np.asarray(ids), valid, 8)
+    assert sel_valid.all()
+    _d, idx = xor_topk(queries, ids, k=8, valid=jnp.asarray(valid))
+    ours = np.asarray(queries)[:, None, :] ^ np.asarray(ids)[sel]
+    theirs = np.asarray(queries)[:, None, :] ^ np.asarray(ids)[
+        np.asarray(idx)]
+    assert np.array_equal(np.sort(ours.view(np.uint32), axis=1),
+                          np.sort(theirs.view(np.uint32), axis=1))
+
+
+# --------------------------------------------------------- fault dynamics
+def test_poison_admission_bounded_and_decays():
+    """Attacker entries are admitted into at most the FREE slots of a
+    victim bucket (full-bucket rejection) and evicted by the first
+    successful maintenance pass after the poison phase ends."""
+    plan = chaos.FaultPlan([
+        chaos.Phase("poison", start=0.0, duration=4.0,
+                    poison=chaos.Poison(victim="g1", per_bucket=8)),
+    ])
+    sim = swarm.SwarmSim(plan, n_nodes=64, n_keys=8, n_groups=2,
+                         seed=9, sweep_sample=8)
+    sim.tick()
+    occ = swarm._unpack_occ(np, np.asarray(sim.state["occ"]))
+    poi = swarm._unpack_occ(np, np.asarray(sim.state["poison"]))
+    group = np.asarray(sim.state["group"])
+    assert poi[group == 1].sum() > 0, "poison never admitted"
+    # the admission invariant: honest + attacker never exceeds k
+    assert int((occ + poi).max()) <= swarm.K_BUCKET
+    # non-victims untouched
+    assert poi[group == 0].sum() == 0
+    # shallow buckets are FULL of honest nodes -> zero attacker entries
+    # land there (the eclipse-resistance property)
+    full = occ == swarm.K_BUCKET
+    assert not (poi[full] > 0).any()
+    sim.run(8)          # phase over; maintenance evicts the sybils
+    poi = swarm._unpack_occ(np, np.asarray(sim.state["poison"]))
+    assert poi.sum() == 0, "attacker occupancy survived the heal"
+
+
+def test_storm_partition_heal_invariants_restore():
+    """The acceptance arc: a join/leave storm plus an asymmetric
+    partition-and-heal, with lookup-success and replica-coverage
+    restored after healing."""
+    plan = chaos.FaultPlan([
+        chaos.Phase("storm", start=1.0, duration=3.0,
+                    storm=chaos.Storm(leave_rate=0.10, join_rate=0.10)),
+        chaos.Phase("refill", start=4.0, duration=3.0,
+                    storm=chaos.Storm(join_rate=0.5)),
+        chaos.Phase("split", start=8.0, duration=6.0,
+                    partition=chaos.Partition(block=[("g0", "g1")],
+                                              symmetric=True)),
+    ], seed=3)
+    sim = swarm.SwarmSim(plan, n_nodes=1024, n_keys=48, n_groups=2,
+                         seed=5, sweep_sample=32, repub_every=2)
+    hist = sim.run(22)
+    assert hist[0]["verdict"] == HEALTHY
+    during = hist[9:13]
+    assert any(m["verdict"] in (DEGRADED, UNHEALTHY) for m in during), \
+        [m["verdict"] for m in during]
+    assert min(m["replica_coverage"] for m in during) < 0.75
+    healed = hist[-1]
+    assert healed["verdict"] == HEALTHY, healed
+    assert healed["lookup_success"] >= 0.95
+    assert healed["replica_coverage"] >= 0.95
+    # storms actually churned the population
+    assert sum(m["n_leave"] for m in hist) > 0
+    assert sum(m["n_join"] for m in hist) > 0
+
+
+def test_swarm_verdict_and_phase_flight_events():
+    """Swarm verdicts ride the PR-9 flight-recorder ring: phase
+    transitions and verdict flips are recorded as events."""
+    from opendht_tpu import tracing
+    tr = tracing.get_tracer()
+    plan = chaos.FaultPlan([
+        chaos.Phase("split", start=2.0, duration=4.0,
+                    partition=chaos.Partition(block=[("g0", "g1")],
+                                              symmetric=True)),
+    ])
+    sim = swarm.SwarmSim(plan, n_nodes=256, n_keys=16, n_groups=2,
+                         seed=4, sweep_sample=16, repub_every=2)
+    sim.run(10)
+    phases = tr.events(name="chaos_phase")
+    verdicts = tr.events(name="swarm_verdict")
+    assert any("split" in e["attrs"].get("active", "")
+               for e in phases), phases
+    assert any(e["attrs"].get("to") in (DEGRADED, UNHEALTHY)
+               for e in verdicts), verdicts
+    from opendht_tpu import telemetry
+    reg = telemetry.get_registry()
+    snap = reg.snapshot()["gauges"]
+    assert "dht_swarm_lookup_success" in snap
+    assert "dht_swarm_replica_coverage" in snap
+
+
+def test_params_at_derivation():
+    plan = full_plan()
+    group = np.array([0, 0, 1, 1], np.int32)
+    p0 = swarm.params_at(plan, 0.0, 2, group)
+    assert p0["reach"].all() and not p0["poison_on"]
+    assert float(p0["loss"]) == 0.0
+    p_split = swarm.params_at(plan, 6.0, 2, group)
+    assert not p_split["reach"][0, 1] and p_split["reach"][1, 0], \
+        "asymmetric partition must block one direction only"
+    assert float(p_split["loss"]) > 0.0      # the lossy phase overlaps
+    p_poison = swarm.params_at(plan, 9.5, 2, group)
+    assert p_poison["poison_on"]
+    assert np.array_equal(p_poison["poison_mask"], group == 1)
+    p_end = swarm.params_at(plan, 20.0, 2, group)
+    assert p_end["reach"].all() and not p_end["poison_on"]
