@@ -115,6 +115,67 @@ def slope_record(stage: str, dt_s: float, **extra) -> dict:
     return rec
 
 
+def add_paired_delta_args(parser, reps: int = 15) -> None:
+    """The shared CLI surface of :func:`paired_delta` (round 19): every
+    overhead driver grew its own ``--reps`` copy; ``--stages`` prints
+    the per-stage latency waterfall next to the headline delta so 'the
+    overhead moved' and 'WHERE the time goes' come from one run."""
+    parser.add_argument("--reps", type=int, default=reps,
+                        help="timed trips per mode (interleaved)")
+    parser.add_argument("--stages", action="store_true",
+                        help="print the per-stage waterfall decomposition "
+                             "(dht_stage_seconds p50/p95 + budgets) next "
+                             "to the paired delta")
+
+
+def paired_delta(trip, reps: int, modes=("off", "on")) -> dict:
+    """The round-9 paired-delta overhead methodology, extracted to ONE
+    copy (round 19 — five drivers had drifted hand-rolled loops): both
+    modes run the SAME compiled executable via ``trip(mode) -> seconds``,
+    one shared warmup pass per mode, then ``reps`` trips per mode
+    interleaved with the mode order rotating per rep (pairing cancels
+    background-load drift on shared hosts).  Returns::
+
+        {"on_pct":  median of per-rep (instrumented-baseline)/baseline,
+         "med_ms":  {mode: median trip ms},   # the noise floor, visible
+         "times":   {mode: [seconds, ...]}}
+
+    ``modes[0]`` is the baseline, ``modes[1]`` the instrumented mode."""
+    import numpy as np
+
+    order = list(modes)
+    times = {m: [] for m in order}
+    for m in order:                          # shared warmup
+        trip(m)
+    for i in range(reps):
+        for m in order[i % len(order):] + order[:i % len(order)]:
+            times[m].append(trip(m))
+    base, instr = order[0], order[1]
+    on_pct = float(np.median(
+        [(s - o) / o for s, o in zip(times[instr], times[base])])) * 100
+    return {
+        "on_pct": on_pct,
+        "med_ms": {m: float(np.median(v) * 1e3)
+                   for m, v in times.items()},
+        "times": times,
+    }
+
+
+def print_stage_waterfall(snapshot: dict) -> None:
+    """Human-readable per-stage table off a ``StageProfiler.snapshot()``
+    — what ``--stages`` (see :func:`add_paired_delta_args`) prints."""
+    print("%-16s %8s %10s %10s %10s" % ("stage", "count", "p50 ms",
+                                        "p95 ms", "budget ms"))
+    budgets = snapshot.get("budgets", {})
+    for stage, d in snapshot.get("stages", {}).items():
+        if not d.get("count"):
+            continue
+        fmt = lambda v: "-" if v is None else "%.3f" % (v * 1e3)  # noqa: E731
+        print("%-16s %8d %10s %10s %10.1f"
+              % (stage, d["count"], fmt(d.get("p50")), fmt(d.get("p95")),
+                 budgets.get(stage, 0.0) * 1e3))
+
+
 def add_profile_arg(parser) -> None:
     parser.add_argument(
         "--profile", default="", metavar="DIR",

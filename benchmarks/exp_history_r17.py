@@ -120,20 +120,17 @@ def main(argv=None) -> int:
             "wave outputs diverged with the history tick enabled"
     del base, ticked
 
-    times: dict = {"off": [], "ticked": []}
-    order = ["off", "ticked"]
-    for i in range(args.reps):
-        for mode in order[i % 2:] + order[:i % 2]:
-            times[mode].append(trip(mode))
+    # round 19: the rotation-interleaved loop + paired-median math moved
+    # to the ONE shared estimator every overhead driver uses
+    pd = dc.paired_delta(trip, args.reps, modes=("off", "ticked"))
 
     # recorder sanity: the timed ticks' frames carry the per-wave deltas
     assert rec.frames(), "recorder appended no frames"
     assert any('dht_ops_total{ok="true",op="get"}' in f["counters"]
                for f in rec.frames())
 
-    on_pct = float(np.median([(s - o) / o for s, o in
-                              zip(times["ticked"], times["off"])])) * 100
-    med = {m: float(np.median(v) * 1e3) for m, v in times.items()}
+    on_pct = pd["on_pct"]
+    med = pd["med_ms"]
     rec_doc = {
         "name": "history_overhead",
         "value": round(on_pct, 3),

@@ -309,7 +309,8 @@ def check_tp_wire(failures):
 #: probe): each capture must beat its own recorded acceptance bound,
 #: and both docs must state the bound
 _OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead",
-                  "cache_overhead", "history_overhead")
+                  "cache_overhead", "history_overhead",
+                  "waterfall_overhead")
 
 
 def check_overhead_captures(failures):
@@ -423,7 +424,7 @@ def check_swarm_storm(failures):
 #: here — adding a surface without registering it fails CI.
 OBS_SURFACES = ("GET /stats", "GET /trace", "GET /healthz",
                 "GET /keyspace", "GET /cache", "GET /history",
-                "GET /debug/bundle", "kernel ledger",
+                "GET /debug/bundle", "GET /profile", "kernel ledger",
                 "dhtscanner --json")
 OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /")
 

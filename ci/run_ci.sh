@@ -375,6 +375,42 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "--ticks", "22"])
 assert rc == 0, "swarm stepper smoke failed"
 PY
+# per-op latency waterfall smoke (round 19): boot a 3-node real-UDP
+# cluster + proxy, run mixed put/get traffic, assert the always-on
+# dht_stage_seconds{stage=} histograms advance on the scrape (queue
+# wait, device launch, scatter-back, real-UDP rpc_wait), GET /profile
+# serves the waterfall JSON + ?fmt=folded flamegraph stacks (400 on a
+# bad fmt), a hot-bucket exemplar trace id reassembles into a span
+# tree through the trace assembler, dhtmon --max-stage exits 0 at a
+# gate above the healthy baseline then 1 under an injected
+# scatter-path stall, and the OPEN-bound tracker drops a well-formed
+# settling record (status="unsettled" on CPU).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.waterfall_smoke import main
+rc = main()
+assert rc == 0, "waterfall smoke failed"
+PY
+# stage-profiler overhead smoke (round 19): with the always-on profiler
+# observing every wave's device stage (compile/execute split + exemplar
+# stamping), the search round must stay inside a generous 5% band vs
+# the profiler-disabled run (the committed
+# captures/waterfall_overhead.json documents the tight number against
+# the <1% acceptance, enforced against the README quote by check_docs
+# above), and the wave outputs stay bit-identical profiler on vs off.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_waterfall_r19", pathlib.Path("benchmarks/exp_waterfall_r19.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "waterfall overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

@@ -732,6 +732,18 @@ def record_wave(out, elapsed_s: float, wave_width: int, *,
             elapsed_s / rounds)
     tr = tracing.get_tracer()
     ctx = tracing.current()
+    # ISSUE-15: the search wave IS the device stage of every op it
+    # carries — feed the waterfall the same timed span, split
+    # compile-vs-execute per launch shape (mode × width) so the bench
+    # loops measure the profiler at its real per-wave hook cost
+    from .. import waterfall
+    wf = waterfall.get_profiler()
+    if wf.enabled:
+        key = ("search", mode, int(wave_width))
+        stage = ("device_compile" if wf.first_launch(key)
+                 else "device_launch")
+        wf.observe(stage, elapsed_s,
+                   exemplar=tracing.current_trace_hex())
     if tr.enabled and ctx is not None:
         end = time.time()
         start = end - elapsed_s

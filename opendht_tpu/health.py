@@ -196,6 +196,14 @@ DEFAULT_SIGNAL_THRESHOLDS = {
     # window; capped at degraded in the verdict (degrade_only): a cold
     # cache is an efficiency problem, not a liveness one.
     "cache_hit_ratio": (0.5, 0.9),
+    # round 19 (ISSUE-15): the waterfall's windowed worst-stage
+    # p95/budget ratio (waterfall.StageProfiler.stage_budget) — 1.0
+    # means the slowest serving stage sits exactly at its budgeted
+    # p95; 2.0 is a 2x blowout.  Unknown until a stage accrues enough
+    # samples in the window, device_compile excluded (one-time XLA
+    # lowering).  Capped at degraded in the verdict (degrade_only): a
+    # slow stage is an efficiency regression, not lost liveness.
+    "stage_budget": (1.0, 2.0),
 }
 
 
@@ -233,7 +241,10 @@ class HealthConfig:
     #: readiness behind a load balancer (review finding).
     #: cache_hit_ratio rides the same cap (round 16): a cold or
     #: miss-heavy cache degrades efficiency, never liveness.
-    degrade_only: tuple = ("shard_imbalance", "cache_hit_ratio")
+    #: stage_budget joins it (round 19): a stage past its latency
+    #: budget is slow serving, not a down node.
+    degrade_only: tuple = ("shard_imbalance", "cache_hit_ratio",
+                           "stage_budget")
 
 
 # ====================================================== window bookkeeping
@@ -697,6 +708,7 @@ class NodeHealth:
                 "stale_buckets": self._stale_buckets,
                 "shard_imbalance": self._shard_imbalance,
                 "cache_hit_ratio": self._cache_hit_ratio,
+                "stage_budget": self._stage_budget,
             })
         self._job = None
 
@@ -764,6 +776,17 @@ class NodeHealth:
             return None
         ratio = hc.hit_ratio()
         return None if ratio is None else 1.0 - ratio
+
+    def _stage_budget(self) -> Optional[float]:
+        """Worst-stage p95/budget ratio from the round-19 latency
+        waterfall over the window since the last health tick (the
+        profiler diffs its stage histograms against the previous call's
+        baselines, so the tick cadence IS the window).  None (unknown,
+        never trips) while no stage accrued enough new samples — a
+        quiet node has no slow stages.  Degrade-only in the verdict
+        (:class:`HealthConfig`.degrade_only)."""
+        from . import waterfall
+        return waterfall.get_profiler().stage_budget()
 
     # --------------------------------------------------------------- tick
     def attach(self, scheduler) -> None:

@@ -515,6 +515,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
                  keyspace: Optional[dict] = None,
                  cache: Optional[dict] = None,
                  ingest: Optional[dict] = None,
+                 waterfall: Optional[dict] = None,
                  tracer: Optional[tracing.Tracer] = None,
                  flight_limit: int = 400) -> dict:
     """Assemble one post-mortem black-box bundle (↔ the reference's
@@ -536,6 +537,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
         "keyspace": keyspace or {},
         "cache": cache or {},
         "ingest": ingest or {},
+        "waterfall": waterfall or {},
         "history": {"enabled": False, "frames": []},
         "flight_recorder": {"spans": [], "events": []},
         "kernels": {},

@@ -19,7 +19,7 @@ import socket as _socket
 from dataclasses import dataclass, field as _field
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .. import telemetry, tracing
+from .. import telemetry, tracing, waterfall
 from ..infohash import InfoHash
 from ..rate_limiter import RateLimiter
 from ..scheduler import Scheduler
@@ -329,6 +329,17 @@ class NetworkEngine:
             node.set_expired()
             if not node.id:
                 self.requests.pop(req.tid, None)
+            # ISSUE-15: an expired RPC is the rpc_wait stage's tail —
+            # set_done only sees replies, so without this sample the
+            # waterfall's network plane would show nothing but the
+            # happy path (the 3.5 s stage budget ≈ full expiry)
+            if req.start != float("-inf"):
+                wf = waterfall.get_profiler()
+                if wf.enabled:
+                    sp = req.trace_span
+                    wf.observe("rpc_wait", max(0.0, now - req.start),
+                               exemplar=(sp.ctx.trace_hex
+                                         if sp is not None else None))
             req.set_expired()
             return
         if req.attempt_count == 1 and req.on_expired:

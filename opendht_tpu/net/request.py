@@ -147,8 +147,21 @@ class Request:
             _metric("counter", "dht_net_requests_completed_total",
                     self.type).inc()
             if self.reply_time != _NEVER and self.start != _NEVER:
+                rtt = max(self.reply_time - self.start, 0.0)
                 _metric("histogram", "dht_net_rtt_seconds", self.type) \
-                    .observe(max(self.reply_time - self.start, 0.0))
+                    .observe(rtt)
+                # ISSUE-15: the same RTT is the waterfall's rpc_wait
+                # stage — the network plane of the per-op story (runs
+                # concurrent with the device stages, so it is excluded
+                # from the per-op sum pin); a hop sent under a sampled
+                # trace stamps its bucket with the hop span's trace id
+                from .. import waterfall
+                wf = waterfall.get_profiler()
+                if wf.enabled:
+                    sp = self.trace_span
+                    wf.observe("rpc_wait", rtt,
+                               exemplar=(sp.ctx.trace_hex
+                                         if sp is not None else None))
             self._finish_span("completed")
             if self.on_done:
                 self.on_done(self, msg)

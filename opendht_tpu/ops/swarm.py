@@ -619,7 +619,7 @@ class SwarmSim:
         self._g = {name: reg.gauge("dht_swarm_" + name)
                    for name in ("alive", "lookup_success",
                                 "replica_coverage", "poison_occupancy",
-                                "model_err")}
+                                "occupancy", "model_err")}
         self._tracer = tracing.get_tracer()
 
     # -- one stepper launch per tick --------------------------------------
@@ -652,6 +652,11 @@ class SwarmSim:
         metrics = {k: int(v) for k, v in metrics.items()}
         self._g["alive"].set(metrics["n_alive"])
         self._g["poison_occupancy"].set(metrics["poison_sum"])
+        # ISSUE-15 satellite: total replica-slot occupancy per tick —
+        # the stepper computed occ_sum from day one but published only
+        # the poison slice, so a swarm soak's history frames carried no
+        # storage-pressure series to bundle at incident time
+        self._g["occupancy"].set(metrics["occ_sum"])
         self._g["model_err"].set(metrics["model_err"])
         return metrics
 

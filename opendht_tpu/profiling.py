@@ -791,7 +791,12 @@ def maybe_export(reg=None) -> int:
     publishes the ledger IF it has been computed, and computes it first
     when ``OPENDHT_TPU_LEDGER=1`` arms eager mode (serving processes
     that want the series on every scrape without an explicit REPL/CI
-    nudge).  Never raises; returns kernels exported (0 = ledger off)."""
+    nudge).  Never raises; returns kernels exported (0 = ledger off).
+
+    The round-19 ``dht_stage_budget_seconds{stage=}`` gauges do NOT
+    ride this hook: the stage profiler publishes them on its own
+    registry at construction/configure time (waterfall.py), so a
+    ledger-off process still pays nothing here on a scrape."""
     try:
         if not _ledger.computed():
             if os.environ.get("OPENDHT_TPU_LEDGER", "") not in (

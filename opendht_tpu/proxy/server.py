@@ -460,6 +460,34 @@ def _make_handler(server: DhtProxyServer):
                 # stays unambiguous.
                 self._send_json(runner.dump_bundle())
                 return
+            if parts == ["profile"]:
+                # GET /profile → the per-op latency waterfall (round
+                # 19, ISSUE-15): per-stage dht_stage_seconds histograms
+                # with p50/p95/p99 + bucket exemplars, the stage
+                # budgets, the per-op decomposition ring and the live
+                # OPEN-bound comparison; ?fmt=folded serves
+                # flamegraph-shaped folded stacks as text/plain
+                # ("stack weight" lines for flamegraph.pl/speedscope).
+                # "profile" is not a valid hash, so — like /stats —
+                # the path was previously a 400 and stays unambiguous.
+                fmt = (_q.get("fmt") or [None])[0]
+                if fmt == "folded":
+                    from .. import waterfall as _wf
+                    body = _wf.get_profiler().folded().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if fmt is not None:
+                    self._err(400, "invalid fmt")
+                    return
+                # get_profile already degrades to {"enabled": False}
+                # on any internal failure — no second wrapper here
+                self._send_json(runner.get_profile())
+                return
             if parts[0] == "trace":
                 # GET /trace[?name=] → the node's flight-recorder dump
                 # (ISSUE-4; the reference's dumpTables as a scrapeable

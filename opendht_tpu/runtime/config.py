@@ -15,6 +15,7 @@ from ..health import HealthConfig, SloObjective, default_slos  # noqa: F401
 from ..history import HistoryConfig  # noqa: F401  (same knob-surface rule)
 from ..keyspace import KeyspaceConfig  # noqa: F401  (same knob-surface rule)
 from ..hotcache import HotCacheConfig  # noqa: F401  (same knob-surface rule)
+from ..waterfall import WaterfallConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -161,6 +162,21 @@ class Config:
     #: (testing/network.py, testing/virtual_net.py) arm with
     #: ``force=True`` instead of flipping this.
     chaos_enabled: bool = False
+
+    # --- per-op latency waterfall (round 19, opendht_tpu/waterfall.py) --
+    #: always-on stage profiler over the full serving path:
+    #: ``dht_stage_seconds{stage=}`` histograms (queue_wait /
+    #: cache_probe / device_compile / device_launch / scatter_back /
+    #: rpc_wait) with exemplar trace ids on the hot buckets, a bounded
+    #: per-op decomposition ring, the degrade-only ``stage_budget``
+    #: health signal, and the live OPEN-bound tracker
+    #: (``dht_open_bound{key=,status=}`` gauges + settling records into
+    #: ``$OPENDHT_TPU_SMOKE_RECORD_DIR``).  Surfaces: ``GET /profile``
+    #: (+ ``?fmt=folded``), the ``profile`` REPL cmd, the scanner's
+    #: ``waterfall`` section and ``dhtmon --max-stage``.
+    #: ``waterfall.enabled = False`` stops observation entirely —
+    #: results are identical either way (the profiler only observes).
+    waterfall: WaterfallConfig = field(default_factory=WaterfallConfig)
 
 
 @dataclass

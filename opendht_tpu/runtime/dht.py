@@ -206,6 +206,17 @@ class Dht:
             clock=self.scheduler.time)
         self.keyspace.subscribe(self.hotcache.on_keyspace_tick)
 
+        # per-op latency waterfall (round 19, ISSUE-15): the always-on
+        # stage profiler every serving layer feeds (wave builder,
+        # search envelope, net engine/request) — process-global like
+        # the registry; this node's config wins, same last-node-wins
+        # aggregation rule (waterfall.py; config.waterfall knobs)
+        from .. import waterfall as _waterfall
+        self.waterfall = _waterfall.get_profiler()
+        self.waterfall.configure(
+            getattr(config, "waterfall", None)
+            or _waterfall.WaterfallConfig())
+
         # t-sharded resolve (round 13): lazily-built (q=1, t) mesh from
         # config.resolve_mesh_t; None until first use, False = probed
         # and unavailable (fewer devices than requested / no jax).

@@ -32,7 +32,8 @@ import threading
 import time as _time
 from typing import Callable, List, Optional, Tuple
 
-from .. import health as _health, history as _history, telemetry, tracing
+from .. import (health as _health, history as _history, telemetry, tracing,
+                waterfall as _waterfall)
 from ..infohash import InfoHash
 from ..sockaddr import SockAddr
 from ..utils import TIME_MAX, lazy_module
@@ -245,6 +246,18 @@ class DhtRunner:
                 self._health.evaluator.on_transition = \
                     self._on_health_transition
             self._health.attach(dht.scheduler)
+
+        # OPEN-bound tracker (round 19): periodic live comparison of
+        # achieved wave p50 / occupancy / churny-static ratio against
+        # the six open perf_budgets.json bounds, on the same scheduler
+        # (registry reads only — no device work); re-drops the settling
+        # record each tick so a smoke harvest collects fresh evidence
+        self._open_bounds = None
+        wcfg = getattr(dht_config, "waterfall", None)
+        period = getattr(wcfg, "open_bound_period", 0.0) if wcfg else 0.0
+        if period > 0:
+            self._open_bounds = _waterfall.OpenBoundTracker()
+            self._open_bounds.attach(dht.scheduler, period=period)
 
         self.running = True
         if config.threaded:
@@ -921,6 +934,7 @@ class DhtRunner:
             keyspace=self.get_keyspace(),
             cache=self.get_cache(),
             ingest=ingest,
+            waterfall=self.get_profile(),
         )
 
     def get_bundles(self) -> list:
@@ -972,6 +986,22 @@ class DhtRunner:
             if hc is None:
                 return {"enabled": False}
             return hc.snapshot()
+        except Exception:
+            return {"enabled": False}
+
+    def get_profile(self) -> dict:
+        """The per-op latency waterfall snapshot (ISSUE-15): per-stage
+        ``dht_stage_seconds`` histograms with p50/p95/p99 and bucket
+        exemplars, the stage budgets, the recent per-op decomposition
+        records and the live OPEN-bound comparison — the JSON the
+        proxy's ``GET /profile`` route serves, the ``profile`` REPL
+        command prints, and the scanner's ``waterfall`` section
+        embeds."""
+        try:
+            doc = _waterfall.get_profiler().snapshot()
+            if self._open_bounds is not None:
+                doc["open_bounds"] = self._open_bounds.snapshot()
+            return doc
         except Exception:
             return {"enabled": False}
 

@@ -66,7 +66,7 @@ import time as _time
 from collections import deque
 from typing import Callable, List
 
-from .. import telemetry, tracing
+from .. import telemetry, tracing, waterfall
 from ..infohash import InfoHash
 from ..rate_limiter import RateLimiter
 
@@ -259,14 +259,36 @@ class WaveBuilder:
         batch = list(self._pending)
         self._pending.clear()
         self._m_depth.set(0)
-        batch = self._serve_cached(batch)
+        # waterfall (round 19): queue_wait = admission → wave pickup,
+        # off the honest enqueue stamp (t_wall, see _Entry) — stamped
+        # here, before the cache probe, so a cache-served op still
+        # contributes its coalesce tax
+        wf = waterfall.get_profiler()
+        t_pick = _time.time()
+        if wf.enabled:
+            for e in batch:
+                wf.observe("queue_wait", max(0.0, t_pick - e.t_wall),
+                           exemplar=e.ctx.trace_hex if e.ctx else None)
+        cache = getattr(self._dht, "hotcache", None)
+        probe_s = 0.0
+        if cache is not None and cache.active():
+            # time the probe ONLY when a cache is actually live — a
+            # cache-off wave would flood the cache_probe histogram
+            # with ~0 samples and bury the real probe's p50
+            t_probe = _time.time()
+            batch = self._serve_cached(batch)
+            probe_s = max(0.0, _time.time() - t_probe)
+            if wf.enabled:
+                wf.observe("cache_probe", probe_s)
+        else:
+            batch = self._serve_cached(batch)
         if not batch:
             return
         groups: dict = {}
         for e in batch:
             groups.setdefault((e.af, e.k), []).append(e)
         for (af, k), entries in groups.items():
-            self._launch(af, k, entries)
+            self._launch(af, k, entries, wf, t_pick, probe_s)
 
     def _serve_cached(self, entries: List[_Entry]) -> List[_Entry]:
         """The serve-from-cache fast path (ISSUE-11): ONE batched
@@ -303,8 +325,12 @@ class WaveBuilder:
                 log.exception("cache-serve callback failed")
         return remaining
 
-    def _launch(self, af: int, k: int, entries: List[_Entry]) -> None:
+    def _launch(self, af: int, k: int, entries: List[_Entry],
+                wf=None, t_pick: "float | None" = None,
+                probe_s: float = 0.0) -> None:
         reg = telemetry.get_registry()
+        if wf is None:
+            wf = waterfall.get_profiler()
         t_fire = _time.time()
         with reg.span("dht_ingest_wave_seconds") as sp:
             try:
@@ -314,6 +340,7 @@ class WaveBuilder:
                 log.exception("ingest wave launch failed (af=%d k=%d Q=%d)",
                               af, k, len(entries))
                 results = None
+        t_launch_end = _time.time()
         if results is None:
             # a failed launch must not fail its carried (already
             # admitted) searches on a transient device error: re-queue
@@ -354,6 +381,17 @@ class WaveBuilder:
         shard_t = int(getattr(self._dht, "last_resolve_shard_t", 1) or 1)
         if shard_t > 1:
             self._m_sharded_waves.inc()
+        # waterfall device stage: the first timed launch of an (af, k)
+        # group carries XLA compilation — split so a one-time lowering
+        # never poisons the serving p99 (host-side bookkeeping only;
+        # the launch itself is untouched)
+        dev_stage = "device_launch"
+        if wf.enabled:
+            dev_stage = ("device_compile" if wf.first_launch((af, k))
+                         else "device_launch")
+            wf.observe(dev_stage, sp.elapsed,
+                       exemplar=next((e.ctx.trace_hex for e in entries
+                                      if e.ctx is not None), None))
 
         # ISSUE-4 spine: one dht.search.wave span per launch (the
         # ingest-mode sibling of the engine's wave span), each carried
@@ -390,6 +428,24 @@ class WaveBuilder:
                 e.cb(nodes)
             except Exception:
                 log.exception("ingest scatter callback failed")
+            if wf.enabled:
+                # per-op decomposition record: stage sum ≈ end-to-end
+                # (admission → this op's scatter returned); rpc_wait
+                # overlaps the device stages and is deliberately absent
+                t_done = _time.time()
+                base = t_pick if t_pick is not None else t_fire
+                wf.record_op(e.kind, {
+                    "queue_wait": max(0.0, base - e.t_wall),
+                    "cache_probe": probe_s,
+                    dev_stage: sp.elapsed,
+                    "scatter_back": max(0.0, t_done - t_launch_end),
+                }, end_to_end=max(0.0, t_done - e.t_wall),
+                    trace_id=e.ctx.trace_hex if e.ctx else None)
+        if wf.enabled:
+            # ONE scatter_back observation per wave (the whole fan-out
+            # loop) — the per-op slices live in the records above
+            wf.observe("scatter_back",
+                       max(0.0, _time.time() - t_launch_end))
 
     # ---------------------------------------------------------- inspection
     def snapshot(self) -> dict:

@@ -129,21 +129,29 @@ class Histogram:
     p50/p95 alerting (testing/network_monitor.py) and far cheaper than
     exact reservoirs on the per-packet hot paths."""
 
-    __slots__ = ("count", "sum", "buckets", "_lock")
+    __slots__ = ("count", "sum", "buckets", "exemplars", "_lock")
 
     def __init__(self):
         self.count = 0
         self.sum = 0.0
         self.buckets: Dict[int, int] = {}
+        # per-bucket latest exemplar (round 19): bucket -> (value,
+        # trace id) — a hot bucket links to a reconstructable trace
+        # through the round-9 assembler.  JSON/snapshot side only; the
+        # prometheus() v0.0.4 text has no exemplar syntax and stays
+        # byte-compatible with pre-exemplar scrapers.
+        self.exemplars: Dict[int, tuple] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         i = _bucket_index(v)
         with self._lock:
             self.count += 1
             self.sum += v
             self.buckets[i] = self.buckets.get(i, 0) + 1
+            if exemplar is not None:
+                self.exemplars[i] = (v, exemplar)
 
     def observe_many(self, values: Iterable[float]) -> None:
         """Bulk insert (one lock, numpy-bucketed when available) — used
@@ -195,11 +203,18 @@ class Histogram:
         with self._lock:
             items = sorted(self.buckets.items())
             count, total = self.count, self.sum
-        return {
+            ex = sorted(self.exemplars.items())
+        out = {
             "count": count,
             "sum": total,
             "buckets": [[_bucket_le(i), c] for i, c in items],
         }
+        if ex:
+            # [upper bound, exemplar value, trace id] — absent (not
+            # empty) when no exemplar was ever stamped, so existing
+            # dict-shape consumers see no new key until they opt in
+            out["exemplars"] = [[_bucket_le(i), v, t] for i, (v, t) in ex]
+        return out
 
 
 class Span:
@@ -425,6 +440,7 @@ class MetricsRegistry:
                             m.count = 0
                             m.sum = 0.0
                             m.buckets.clear()
+                            m.exemplars.clear()
                     else:
                         m.value = 0
 

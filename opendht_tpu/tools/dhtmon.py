@@ -31,12 +31,39 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 from typing import List, Optional
 
-from ..health import parse_alerts, percentile_breaches
+from ..health import (parse_alerts, percentile_breaches,
+                      quantile_from_cumulative)
 from ..testing import health_monitor as hm
+from ..waterfall import STAGES
+
+#: ``dht_stage_seconds_bucket{stage="queue_wait",le="0.001"}`` →
+#: (stage, le) — both label orders, like health_monitor._BUCKET_RE
+_STAGE_BUCKET_RE = re.compile(
+    r'^dht_stage_seconds_bucket\{le="([^"]+)",stage="([^"]+)"\}$'
+    r'|^dht_stage_seconds_bucket\{stage="([^"]+)",le="([^"]+)"\}$')
+
+
+def _stage_p95s(series: dict) -> dict:
+    """Per-stage p95 off one node's scraped ``dht_stage_seconds``
+    buckets (+Inf dropped; a never-observed stage exports no finite
+    buckets and is simply absent — unknown, never a violation)."""
+    per: dict = {}
+    for name, v in series.items():
+        m = _STAGE_BUCKET_RE.match(name)
+        if not m:
+            continue
+        le_s, stage = ((m.group(1), m.group(2)) if m.group(1) is not None
+                       else (m.group(4), m.group(3)))
+        if le_s == "+Inf":
+            continue
+        per.setdefault(stage, []).append((float(le_s), v))
+    return {stage: quantile_from_cumulative(sorted(pairs), 0.95)
+            for stage, pairs in per.items()}
 
 
 def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
@@ -46,7 +73,8 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                sample_max: int = 64, k: int = 8, mesh=None,
                window: float = 0.0, since: Optional[float] = None,
                max_imbalance: Optional[float] = None,
-               min_cache_hit: Optional[float] = None) -> tuple:
+               min_cache_hit: Optional[float] = None,
+               max_stage: Optional[dict] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
     is the JSON-able cluster report and ``violations`` is a list of
     human-readable invariant failures (empty = healthy).
@@ -84,7 +112,14 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     worst node's ``dht_cache_hit_ratio`` gauge (windowed hits /
     eligible probes) must not drop below it — the SAME unknown
     contract as ``max_imbalance``: a -1/absent gauge (cache disabled,
-    dark, or no probes in the window) never violates."""
+    dark, or no probes in the window) never violates.
+
+    ``max_stage`` ({stage: seconds}) gates the round-19 latency
+    waterfall: the worst node's per-stage p95 off its scraped
+    ``dht_stage_seconds`` buckets must not exceed the stage's
+    threshold.  Per-node like the other gauge gates (one slow node
+    must not hide inside a cluster merge); a never-observed stage is
+    unknown and never violates."""
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
@@ -210,6 +245,23 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                        key=lambda p: p["hit_ratio"]
                        if p["hit_ratio"] is not None else 2.0)
                    ["endpoint"]))
+    if max_stage and scrapes:
+        # per-node, worst = MAX p95 per stage: the gate is "no node's
+        # serving stage blew its latency budget" — a stage with no
+        # finite buckets (never observed) is unknown, never a violation
+        per_node = [{"endpoint": s["endpoint"],
+                     "p95": _stage_p95s(s["series"])} for s in scrapes]
+        worst: dict = {}
+        for stage, thr in sorted(max_stage.items()):
+            vals = [(p["p95"][stage], p["endpoint"]) for p in per_node
+                    if p["p95"].get(stage) is not None]
+            w = max(vals) if vals else None
+            worst[stage] = {"p95": w[0] if w else None, "threshold": thr}
+            if w is not None and w[0] > thr:
+                violations.append(
+                    "stage %s p95 %.4fs exceeds %.4fs (worst node %s)"
+                    % (stage, w[0], thr, w[1]))
+        doc["stages"] = {"worst": worst, "per_node": per_node}
     if runners:
         cov = hm.replica_coverage(runners, sample_max=sample_max, k=k,
                                   mesh=mesh)
@@ -284,6 +336,15 @@ def main(argv=None) -> int:
                         "below R — unknown (-1/absent: cache disabled "
                         "or no probe window) never violates, matching "
                         "the --max-imbalance contract")
+    p.add_argument("--max-stage", action="append", default=[],
+                   metavar="STAGE=SEC",
+                   help="fail when any node's p95 for a round-19 "
+                        "waterfall stage (dht_stage_seconds: "
+                        "queue_wait, cache_probe, device_compile, "
+                        "device_launch, scatter_back, rpc_wait) "
+                        "exceeds SEC (repeatable, e.g. --max-stage "
+                        "device_launch=0.25); a never-observed stage "
+                        "is unknown and never violates")
     p.add_argument("--json", action="store_true",
                    help="emit the full cluster report as one JSON doc")
     args = p.parse_args(argv)
@@ -292,6 +353,18 @@ def main(argv=None) -> int:
     except ValueError as e:
         print("dhtmon:", e, file=sys.stderr)
         return 2
+    max_stage: dict = {}
+    for spec in args.max_stage:
+        stage, eq, sec = spec.partition("=")
+        try:
+            if not eq or stage not in STAGES:
+                raise ValueError
+            max_stage[stage] = float(sec)
+        except ValueError:
+            print("dhtmon: invalid --max-stage %r (want STAGE=SEC, "
+                  "STAGE one of %s)" % (spec, ", ".join(STAGES)),
+                  file=sys.stderr)
+            return 2
     endpoints = [ep for spec in args.nodes for ep in spec.split(",") if ep]
     if not endpoints:
         print("dhtmon: no --nodes given", file=sys.stderr)
@@ -302,7 +375,8 @@ def main(argv=None) -> int:
             require_ready=args.require_ready, op=args.op,
             window=args.window, since=args.since,
             max_imbalance=args.max_imbalance,
-            min_cache_hit=args.min_cache_hit)
+            min_cache_hit=args.min_cache_hit,
+            max_stage=max_stage or None)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
         return 2
@@ -333,6 +407,11 @@ def main(argv=None) -> int:
             print("cache hit ratio: %s (worst node)" % (
                 "%.3f" % ch["min"] if ch["min"] is not None
                 else "unknown"))
+        for stage, w in sorted((doc.get("stages") or {})
+                               .get("worst", {}).items()):
+            print("stage %s p95: %s (max %.4fs, worst node)" % (
+                stage, "%.4fs" % w["p95"] if w["p95"] is not None
+                else "unknown", w["threshold"]))
     for v in violations:
         print("ALERT:", v, file=sys.stderr)
     return 1 if violations else 0

@@ -49,6 +49,15 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            data the proxy serves on GET /keyspace;
                            'json' dumps the full snapshot (incl. the
                            256-bin histogram)
+    profile [json|folded]  per-op latency waterfall (round 19): per-
+                           stage p50/p95/p99 (queue_wait, cache_probe,
+                           device_compile/launch, scatter_back,
+                           rpc_wait), the stage budgets and the live
+                           OPEN-bound comparison — the same data the
+                           proxy serves on GET /profile; 'json' dumps
+                           the full snapshot (incl. per-op records +
+                           bucket exemplars), 'folded' prints
+                           flamegraph-shaped folded stacks
     cache [json]           hot-key serving cache (round 16): occupancy,
                            per-entry hit counts, windowed hit ratio,
                            invalidation/eviction totals and the
@@ -305,6 +314,43 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                                  else "", ent["ttl_s"]))
                     if not snap["entries"]:
                         print("  (no hot keys cached yet)")
+            elif op == "profile":
+                # per-op latency waterfall (ISSUE-15): same snapshot
+                # the proxy serves on GET /profile (?fmt=folded for
+                # the 'folded' form)
+                import json as _json
+                if rest and rest[0] == "folded":
+                    from .. import waterfall as _wf
+                    print(_wf.get_profiler().folded(), end="")
+                    continue
+                snap = node.get_profile()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("waterfall profiler disabled")
+                else:
+                    budgets = snap.get("budgets", {})
+                    print("%-16s %8s %10s %10s %10s %10s" % (
+                        "stage", "count", "p50 ms", "p95 ms", "p99 ms",
+                        "budget ms"))
+                    for stage, d in snap["stages"].items():
+                        if not d.get("count"):
+                            continue
+                        print("%-16s %8d %10.3f %10.3f %10.3f %10.1f" % (
+                            stage, d["count"], d["p50"] * 1e3,
+                            d["p95"] * 1e3, d["p99"] * 1e3,
+                            budgets.get(stage, 0.0) * 1e3))
+                    ops = snap.get("ops", [])
+                    print("%d per-op record(s) retained" % len(ops))
+                    ob = snap.get("open_bounds")
+                    if ob:
+                        print("open bounds (%s, status %s):" % (
+                            ob["platform"], ob["status"]))
+                        for key_, b in sorted(ob["bounds"].items()):
+                            print("  %-26s %s" % (
+                                key_, "%.3f" % b["value"]
+                                if b["value"] is not None
+                                else "no measurement"))
             elif op == "bundle":
                 # post-mortem black-box bundle (round 17): same
                 # artifact the proxy serves on GET /debug/bundle

@@ -65,8 +65,18 @@ def topology_snapshot(node) -> dict:
         "health": {},
         "keyspace": {},
         "cache": {},
+        "waterfall": {},
+        "chaos": {},
         "events": [],
     }
+    try:
+        # round-19 latency waterfall: per-stage p50/p95/p99 + budgets +
+        # the live OPEN-bound comparison, so a soak diff shows WHERE an
+        # op's milliseconds went between snapshots, not just the
+        # end-to-end total
+        snap["waterfall"] = node.get_profile()
+    except Exception:
+        pass
     try:
         # round-16 hot-key serving cache: occupancy, hit ratio and the
         # widened hot set, so a soak diff shows WHICH keys the acting
@@ -122,6 +132,14 @@ def topology_snapshot(node) -> dict:
         snap["maintenance"].update(
             (k, v) for k, v in metrics.get("gauges", {}).items()
             if k.startswith("dht_maintenance_"))
+        # round-18 chaos plane (ISSUE-15 satellite): the fault
+        # injector's per-rule drop/dup/reorder/delay accounting
+        # (dht_chaos_injected_total{action=,rule=}) — armed storms were
+        # counted on the registry but surfaced nowhere; a soak diff now
+        # shows which rules actually fired between snapshots
+        snap["chaos"] = {
+            k: v for k, v in metrics.get("counters", {}).items()
+            if k.startswith("dht_chaos_")}
     except Exception:
         pass
     for af, fam in ((socket.AF_INET, "ipv4"), (socket.AF_INET6, "ipv6")):

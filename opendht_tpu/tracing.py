@@ -150,6 +150,16 @@ def current() -> Optional[TraceContext]:
     return _CURRENT.get()
 
 
+def current_trace_hex() -> Optional[str]:
+    """The ambient trace id as its canonical 32-hex form, or None —
+    the exemplar stamp (round 19): waterfall stage observations made
+    under a sampled op link their histogram bucket to a trace the
+    round-9 assembler can reconstruct.  One contextvar read + one
+    format on the sampled path; a single None-check otherwise."""
+    ctx = _CURRENT.get()
+    return ctx.trace_hex if ctx is not None else None
+
+
 class activate:
     """``with tracing.activate(ctx): ...`` — sets the ambient context
     for the block (including to None: a search step must not inherit a

@@ -537,3 +537,32 @@ def test_sybil_flood_rate_limited_at_default_ingress():
     admitted = len(table)
     assert admitted <= victim.config.max_req_per_sec // 8, admitted
     assert admitted > 0
+
+
+def test_chaos_counters_ride_the_metrics_surfaces():
+    """ISSUE-15 satellite: every injection the FaultInjector counts is
+    mirrored to the shared registry as dht_chaos_injected_total
+    {action=, rule=} — so it rides DhtRunner.get_metrics() and the
+    proxy's GET /stats exposition with no extra plumbing."""
+    from opendht_tpu import telemetry
+
+    reg = telemetry.MetricsRegistry()
+    plan = FaultPlan([Phase("lossy", rules=[
+        LinkRule(name="wan", loss=1.0)])], seed=3)
+    inj = FaultInjector(plan, registry=reg)
+    inj.arm(0.0)
+    for _ in range(7):
+        inj.fate("x", "y", 0.1)
+    snap = reg.snapshot()["counters"]
+    key = 'dht_chaos_injected_total{action="dropped",rule="wan"}'
+    assert snap.get(key) == inj.counts["wan"]["dropped"] == 7
+    # the exposition GET /stats serves carries the same series
+    assert 'dht_chaos_injected_total{action="dropped",rule="wan"} 7' \
+        in reg.prometheus()
+    # unarmed-by-default injectors fall back to the process registry —
+    # the path live nodes take (Config.chaos_enabled)
+    g_inj = FaultInjector(plan)
+    g_inj.arm(0.0)
+    g0 = telemetry.get_registry().snapshot()["counters"].get(key, 0)
+    g_inj.fate("x", "y", 0.1)
+    assert telemetry.get_registry().snapshot()["counters"][key] == g0 + 1

@@ -355,6 +355,61 @@ def test_timeline_accepts_bundles_with_events():
     assert len(evs) == 1 and evs[0]["attrs"]["to"] == "unhealthy"
 
 
+def test_timeline_single_source_gets_default_node_name():
+    """A lone raw frame list (single-node cluster, no node_id anywhere)
+    assembles under the positional default name — not a crash, not an
+    anonymous ''."""
+    frames = [{"seq": 1, "t": 10.0, "counters": {"x_total": 1},
+               "gauges": {}, "hist": {}},
+              {"seq": 2, "t": 11.0, "counters": {"x_total": 2},
+               "gauges": {}, "hist": {}}]
+    tl = ta.assemble_timeline([frames])
+    assert tl["nodes"] == ["source-0"]
+    assert [f["node"] for f in tl["frames"]] == ["source-0"] * 2
+    assert tl["skew"] == {"source-0": 0.0}
+    assert not tl["violations"]
+    assert tl["span"] == [10.0, 11.0]
+    # unskewed: adjusted time is the original time
+    assert [f["t_adj"] for f in tl["frames"]] == [10.0, 11.0]
+
+
+def test_timeline_empty_histories():
+    """No sources / sources with no frames: an EMPTY timeline, not an
+    exception — span None so callers can tell 'nothing' from 't=0'."""
+    tl = ta.assemble_timeline([])
+    assert tl == {"nodes": [], "frames": [], "events": [], "skew": {},
+                  "violations": [], "span": None}
+    doc = {"node_id": "ee", "enabled": True, "frames": []}
+    tl2 = ta.assemble_timeline([doc, []])
+    assert tl2["nodes"] == ["ee", "source-1"]
+    assert tl2["frames"] == [] and tl2["span"] is None
+    assert not tl2["violations"]
+    assert ta.window_series(tl2) == {}
+
+
+def test_timeline_non_monotonic_dip_reports_once_and_keeps_frames():
+    """One backwards time jump past CLOCK_SLACK reports exactly ONE
+    violation — the high-water comparison keeps a recovered clock from
+    cascading a violation per subsequent frame — and every frame stays
+    in the merged timeline (report, don't drop).  Jitter inside
+    CLOCK_SLACK is not a violation."""
+    def mk(seq, t):
+        return {"seq": seq, "t": t, "counters": {"y_total": 1},
+                "gauges": {}, "hist": {}}
+    doc = {"node_id": "ff",
+           "frames": [mk(1, 20.0), mk(2, 19.0), mk(3, 20.5)]}
+    tl = ta.assemble_timeline([doc])
+    assert len(tl["frames"]) == 3, "violating frames must be retained"
+    assert len(tl["violations"]) == 1, tl["violations"]
+    assert "before its predecessor" in tl["violations"][0]
+    # summed series still counts every retained frame
+    assert ta.window_series(tl)["y_total"] == 3
+    # scheduling jitter within the slack: clean
+    ok = {"node_id": "gg",
+          "frames": [mk(1, 20.0), mk(2, 20.0 - ta.CLOCK_SLACK / 2)]}
+    assert not ta.assemble_timeline([ok])["violations"]
+
+
 # ======================================================= live runner glue
 def test_runner_history_and_bundle_surfaces():
     """One live node: the recorder ticks on the scheduler, GET-style

@@ -199,3 +199,39 @@ def test_params_at_derivation():
     assert np.array_equal(p_poison["poison_mask"], group == 1)
     p_end = swarm.params_at(plan, 20.0, 2, group)
     assert p_end["reach"].all() and not p_end["poison_on"]
+
+
+def test_occupancy_gauge_rides_registry_and_history_frames():
+    """ISSUE-15 satellite: the stepper's per-tick total replica-slot
+    occupancy publishes as the dht_swarm_occupancy gauge (it was
+    computed but dropped before), so the round-17 history ring — which
+    samples every registry family — carries the storage-pressure
+    series into soak frames and black-box bundles."""
+    from opendht_tpu import telemetry
+    from opendht_tpu.history import HistoryConfig, MetricsHistory
+
+    reg = telemetry.get_registry()
+    # earlier tests run sims on this shared registry; prime the gauges
+    # to a sentinel so the sim's sets register as CHANGES in the
+    # last-value-when-changed frame encoding
+    reg.gauge("dht_swarm_occupancy").set(-12345.0)
+    reg.gauge("dht_swarm_replica_coverage").set(-12345.0)
+    clock = [0.0]
+    rec = MetricsHistory(HistoryConfig(period=1.0, capacity=8),
+                         registry=reg, clock=lambda: clock[0])
+    rec.tick()                               # baseline
+    plan = chaos.FaultPlan([])
+    sim = swarm.SwarmSim(plan, n_nodes=128, n_keys=8, seed=6,
+                         sweep_sample=16)
+    m = sim.tick()
+    assert m["occ_sum"] > 0
+    snap = reg.snapshot()["gauges"]
+    assert snap.get("dht_swarm_occupancy") == m["occ_sum"]
+    clock[0] = 1.0
+    f = rec.tick()
+    assert f["gauges"]["dht_swarm_occupancy"] == m["occ_sum"]
+    # coverage rides the same frame once the verdict tick computes it
+    sim.run(2)
+    clock[0] = 2.0
+    f2 = rec.tick()
+    assert "dht_swarm_replica_coverage" in f2["gauges"]
