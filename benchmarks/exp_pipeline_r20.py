@@ -1,0 +1,285 @@
+"""Async double-buffered wave pipeline (round-20 tentpole,
+runtime/wave_builder.py): paired-delta of ``ingest_pipeline_depth=2``
+vs ``=1`` under sustained ingest on a device-scale table.
+
+Round 12 coalesced a pump's worth of live refills into one ``[Q]``
+lookup launch, but the launch itself stayed synchronous: the wave
+builder blocked inside ``find_closest_nodes_batched`` until the device
+returned, then paid the host scatter (row→Node materialization +
+callback delivery) with the device idle.  Round 20 splits every layer
+of the resolve into ``launch()``/``consume()`` (core/table.py
+``PendingLookup``, runtime/dht.py ``BatchedResolve``) and keeps
+``ingest_pipeline_depth`` waves in flight: wave N computes on the
+device while wave N+1 fills from the admission queue and wave N−1's
+scatter drains on the host.
+
+This driver measures exactly that trade, through the SHIPPING
+``WaveBuilder`` (``submit()`` + scheduler pumps — the live ingest
+loop, not a synthetic harness):
+
+  depth1    one wave in flight: fire = launch → block → scatter
+            (the exact pre-round-20 serial path, via the escape hatch)
+  depth2    double-buffered: wave N−1's scatter overlaps wave N's
+            device time (``trip`` = submit W waves of Q ops, wall
+            seconds until every callback delivered)
+
+Methodology is driver_common.paired_delta (interleaved reps, shared
+warmup, per-rep pairing cancels background-load drift).  Bit-identity
+is asserted in the same run: depth 2 must deliver per-op node lists
+identical to depth 1 over the same bulk-loaded table.
+
+``--capture pipeline_overlap`` writes captures/pipeline_overlap.json;
+README/PARITY quote the overlap figure under
+``<!-- capture:pipeline_overlap -->`` (ci/check_docs.py enforces the
+quotes both directions).  ``--smoke`` is the CI form: small shapes,
+bit-identity + a deterministic ≥2-waves-in-flight machinery check
+(slow-ready handle wrapper) + a generous timing band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)          # driver_common
+import driver_common as dc         # noqa: E402  (puts the repo root on sys.path)
+
+AF = socket.AF_INET
+
+
+def _build_dht(n: int, depth: int, q: int, seed: int = 31):
+    """A v4-only Dht over a swallow-everything transport with an n-row
+    bulk-loaded, addr-servable table and the wave builder configured to
+    fire at fill target ``q`` with pipeline depth ``depth``."""
+    from opendht_tpu.runtime import Config, Dht
+    from opendht_tpu.scheduler import Scheduler
+    from opendht_tpu.sockaddr import SockAddr
+
+    clock = {"t": 1000.0}
+    cfg = Config(ingest_fill_target=q, ingest_deadline=0.002,
+                 ingest_pipeline_depth=depth)
+    dht = Dht(lambda data, addr: 0, config=cfg,
+              scheduler=Scheduler(clock=lambda: clock["t"]), has_v6=False)
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 2 ** 32, size=(n, 5), dtype=np.uint32)
+    dht.tables[next(iter(dht.tables))].bulk_load(
+        ids, now=clock["t"], addrs=SockAddr("10.7.0.1", 4222))
+    return dht, clock
+
+
+def _targets(n_targets: int, seed: int = 77):
+    from opendht_tpu.infohash import InfoHash
+    rng = np.random.default_rng(seed)
+    return [InfoHash(bytes(rng.integers(0, 256, 20, dtype=np.uint8)))
+            for _ in range(n_targets)]
+
+
+def _run_waves(dht, clock, targets, q: int, k: int, waves: int):
+    """Submit ``waves`` waves of ``q`` ops through the shipping
+    ``WaveBuilder`` and pump the scheduler until every callback fires.
+    Returns (wall_seconds, per-op node lists in submission order)."""
+    wb = dht.wave_builder
+    out = [None] * (waves * q)
+    done = {"n": 0}
+
+    def cb_for(i):
+        def cb(nodes):
+            out[i] = nodes
+            done["n"] += 1
+        return cb
+
+    t0 = time.perf_counter()
+    for w in range(waves):
+        for j in range(q):
+            i = w * q + j
+            wb.submit(targets[i], AF, k, cb_for(i))
+        dht.scheduler.run()          # fill target pulled the trigger
+        clock["t"] += 1e-4
+        dht.scheduler.sync_time()
+    guard = time.perf_counter() + 120
+    while done["n"] < waves * q:     # tail: drain the in-flight waves
+        clock["t"] += 0.002          # past any drainer re-poll deadline
+        dht.scheduler.sync_time()
+        dht.scheduler.run()
+        if time.perf_counter() > guard:
+            raise RuntimeError("pipeline drain stalled: %d/%d delivered"
+                               % (done["n"], waves * q))
+    dt = time.perf_counter() - t0
+    assert all(r is not None for r in out)
+    return dt, out
+
+
+def _ids(results):
+    return [[n.id for n in nodes] for nodes in results]
+
+
+class _SlowReady:
+    """Handle wrapper that reports not-ready on its first poll — makes
+    the ≥2-waves-in-flight smoke assertion deterministic on hosts where
+    the real device result materializes before the next fire."""
+
+    def __init__(self, handle):
+        self._h = handle
+        self.shard_t = handle.shard_t
+        self._polls = 0
+
+    def ready(self):
+        self._polls += 1
+        return self._polls > 1 and self._h.ready()
+
+    def consume(self):
+        return self._h.consume()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-N", type=int, default=65536, help="table rows")
+    p.add_argument("-Q", type=int, default=64,
+                   help="wave width (the fill target)")
+    p.add_argument("-k", type=int, default=14,
+                   help="refill k (live_search.SEARCH_NODES)")
+    p.add_argument("--waves", type=int, default=24,
+                   help="waves per timed trip (sustained ingest)")
+    dc.add_paired_delta_args(p, reps=9)
+    p.add_argument("--capture", default="",
+                   help="write captures/<name>.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI form: small shapes, bit-identity + "
+                        "in-flight machinery + generous timing band")
+    args = p.parse_args(argv)
+
+    import jax
+
+    n, q, waves, reps = ((8192, 16, 6, 3) if args.smoke
+                         else (args.N, args.Q, args.waves, args.reps))
+    k = args.k
+    targets = _targets(waves * q)
+
+    dhts = {}
+    for depth in (1, 2):
+        dhts[depth] = _build_dht(n, depth, q)
+
+    # ---- bit-identity: depth 2 must deliver depth 1's exact results
+    _, r1 = _run_waves(*dhts[1], targets, q, k, waves)
+    _, r2 = _run_waves(*dhts[2], targets, q, k, waves)
+    assert _ids(r1) == _ids(r2), (
+        "depth-2 pipeline diverged from depth-1 results")
+    snap2 = dhts[2][0].wave_builder.snapshot()
+
+    # ---- paired delta: wall per trip, depth1 baseline
+    def trip(mode):
+        depth = 1 if mode == "depth1" else 2
+        dt, _ = _run_waves(*dhts[depth], targets, q, k, waves)
+        return dt
+
+    pd = dc.paired_delta(trip, reps, modes=("depth1", "depth2"))
+    overlap_pct = -pd["on_pct"]      # + = depth2 faster (overlap won)
+
+    # ---- the stage-histogram evidence: one extra trip per mode with
+    # before/after dht_stage_seconds{stage=} deltas.  The device stage
+    # is measured at CONSUME (dispatch + blocking wait) since round 20,
+    # so depth 2's device_launch mean shrinks by exactly the compute
+    # that elapsed while the host filled the next wave — the overlap,
+    # visible in the histograms themselves.
+    from opendht_tpu import waterfall
+
+    def _stage_counts():
+        snap = waterfall.get_profiler().snapshot()["stages"]
+        return {s: (d.get("count", 0), d.get("sum", 0.0))
+                for s, d in snap.items()}
+
+    stage_delta = {}
+    for depth in (1, 2):
+        before = _stage_counts()
+        _run_waves(*dhts[depth], targets, q, k, waves)
+        after = _stage_counts()
+        stage_delta[depth] = {
+            s: {"ops": c1 - c0,
+                "mean_ms": round((s1 - s0) / (c1 - c0) * 1e3, 4)}
+            for s, (c1, s1) in after.items()
+            for c0, s0 in [before.get(s, (0, 0.0))] if c1 > c0}
+
+    def _dev_ms(depth):
+        d = stage_delta[depth]
+        return (d.get("device_launch") or d.get("device_compile")
+                or {"mean_ms": 0.0})["mean_ms"]
+
+    rec = dc.emit({
+        "driver": "exp_pipeline_r20",
+        "N": n, "Q": q, "k": k, "waves": waves,
+        "depth1_ms": round(pd["med_ms"]["depth1"], 3),
+        "depth2_ms": round(pd["med_ms"]["depth2"], 3),
+        "pipeline_overlap_pct": round(overlap_pct, 1),
+        "device_stage_ms_depth1": _dev_ms(1),
+        "device_stage_ms_depth2": _dev_ms(2),
+        "inflight_peak": snap2.get("inflight_peak", 0),
+        "bit_identical": True,
+        "platform": jax.default_backend(),
+    })
+
+    if args.smoke:
+        # machinery: a slow-ready handle makes the double-buffer hold
+        # two waves in flight deterministically
+        sdht, sclock = _build_dht(n, 2, q)   # same table seed → same rows
+        real = sdht.find_closest_nodes_launch
+        sdht.find_closest_nodes_launch = (
+            lambda t, af, c: _SlowReady(real(t, af, c)))
+        _, rs = _run_waves(sdht, sclock, targets, q, k, waves)
+        ssnap = sdht.wave_builder.snapshot()
+        assert ssnap["inflight_peak"] >= 2, (
+            "pipeline never held 2 waves in flight: %r" % (ssnap,))
+        assert _ids(rs) == _ids(r1), (
+            "deferred-drain results diverged from depth-1")
+        # band: the pipeline must not regress sustained ingest (generous
+        # bound — CI hosts are noisy; the full-shape figure is captured)
+        assert pd["med_ms"]["depth2"] <= pd["med_ms"]["depth1"] * 1.6, (
+            "depth-2 pipeline regressed sustained ingest: %r" % pd["med_ms"])
+        print("pipeline smoke ok: overlap %+.1f%%, inflight_peak %d"
+              % (overlap_pct, ssnap["inflight_peak"]))
+        return 0
+
+    if args.capture:
+        dc.write_capture(args.capture, {
+            "metric": ("async double-buffered wave pipeline, live ingest "
+                       "path: wall per trip of %d sustained Q=%d waves "
+                       "through the shipping WaveBuilder (submit + "
+                       "scheduler pumps, device launch + host scatter + "
+                       "callback delivery), ingest_pipeline_depth=2 vs "
+                       "the depth=1 serial escape hatch, paired-delta "
+                       "interleaved reps, platform=cpu; value = %% wall "
+                       "reduction from overlap" % (waves, q)),
+            "value": round(overlap_pct, 1),
+            "unit": "% wall reduction, depth 2 vs depth 1 (cpu)",
+            "bound": {
+                "N": n, "Q": q, "k": k, "waves": waves,
+                "depth1_ms": rec["depth1_ms"],
+                "depth2_ms": rec["depth2_ms"],
+                "pipeline_overlap_pct": rec["pipeline_overlap_pct"],
+                "inflight_peak": rec["inflight_peak"],
+                "bit_identical": True,
+            },
+            # dht_stage_seconds deltas for one trip per mode — the
+            # device stage is timed at consume, so the depth-2 shrink
+            # vs depth 1 IS the compute hidden under host fill time
+            "stages_depth1": stage_delta[1],
+            "stages_depth2": stage_delta[2],
+            "accelerator_target": (
+                "cpu overlap is bounded by the host-side scatter "
+                "fraction (XLA CPU compute and the Python scatter share "
+                "cores); on TPU the device stage is genuinely off-host, so "
+                "the double-buffer hides the entire scatter+fill cost under "
+                "device time.  Settle on an accelerator session: python "
+                "benchmarks/exp_pipeline_r20.py --capture "
+                "pipeline_overlap"),
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -417,6 +417,81 @@ def check_swarm_storm(failures):
                     f"quote the {want_cov} mid-cut coverage collapse")
 
 
+def check_pipeline_overlap(failures):
+    """Round-20 rule, BOTH directions: the committed wave-pipeline
+    acceptance artifact (``captures/pipeline_overlap.json``) must
+    itself record the two non-negotiables — depth-2 bit-identical to
+    depth-1 and >=2 waves held in flight — and README *and* PARITY
+    must each carry a ``<!-- capture:pipeline_overlap -->``-tagged
+    paragraph quoting the measured overlap figure and the in-flight
+    peak; a tagged claim without the artifact (or vice versa) fails."""
+    cap_path = os.path.join(ROOT, "captures", "pipeline_overlap.json")
+    cap = None
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            cap = json.load(f)
+        bound = cap.get("bound", {})
+        if not bound.get("bit_identical"):
+            failures.append(
+                "captures/pipeline_overlap.json: bit_identical is not "
+                "true — the pipeline's results diverged from depth 1")
+        if bound.get("inflight_peak", 0) < 2:
+            failures.append(
+                "captures/pipeline_overlap.json: inflight_peak=%r — the "
+                "double-buffer never held 2 waves in flight"
+                % bound.get("inflight_peak"))
+    tag = "<!-- capture:pipeline_overlap -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if cap is None:
+            if tagged:
+                failures.append(f"{name}: '{tag}' claim with no "
+                                f"captures/pipeline_overlap.json artifact")
+            continue
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the wave-pipeline measurement")
+            continue
+        want_val = "%.1f%%" % cap.get("value", 0.0)
+        want_peak = "%d waves in flight" % cap.get(
+            "bound", {}).get("inflight_peak", 0)
+        dev1 = cap.get("stages_depth1", {}).get("device_launch", {})
+        dev2 = cap.get("stages_depth2", {}).get("device_launch", {})
+        for li in tagged:
+            para = _para_at(lines, li)
+            if want_val not in para:
+                failures.append(
+                    f"{name}: [capture:pipeline_overlap] paragraph does "
+                    f"not quote the measured {want_val} overlap delta")
+            if want_peak not in para:
+                failures.append(
+                    f"{name}: [capture:pipeline_overlap] paragraph does "
+                    f"not quote the '{want_peak}' pipeline peak")
+            # the stage-histogram evidence: the quoted device-stage
+            # shrink must track the artifact's dht_stage_seconds deltas
+            if dev1 and dev2:
+                quoted = re.findall(
+                    r"device stage mean (\d+(?:\.\d+)?) → "
+                    r"(\d+(?:\.\d+)?) ms", para)
+                if not quoted:
+                    failures.append(
+                        f"{name}: [capture:pipeline_overlap] paragraph "
+                        f"does not quote the 'device stage mean A → B "
+                        f"ms' histogram shrink")
+                for q1, q2 in quoted:
+                    for q, w, which in ((q1, dev1["mean_ms"], "depth-1"),
+                                        (q2, dev2["mean_ms"], "depth-2")):
+                        if not (0.85 * w <= float(q) <= 1.15 * w):
+                            failures.append(
+                                f"{name}: [capture:pipeline_overlap] "
+                                f"quotes {q} ms vs the artifact's "
+                                f"{which} device-stage mean {w} (±15%)")
+
+
 #: the observability index (ISSUE-10 satellite): every serving surface
 #: and the reference counterpart(s) it maps to.  BOTH directions: each
 #: surface must appear as a row of the tagged table in README AND
@@ -548,6 +623,7 @@ def main() -> int:
     check_tp_wire(failures)
     check_overhead_captures(failures)
     check_swarm_storm(failures)
+    check_pipeline_overlap(failures)
     check_observability_index(failures)
     check_trajectory(failures)
     if failures:

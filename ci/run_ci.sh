@@ -411,6 +411,41 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "waterfall overhead smoke failed"
 PY
+# wave-pipeline smoke (round 20): boot a 3-node real-UDP cluster +
+# proxy, run the concurrent mixed burst at ingest_pipeline_depth=2 and
+# assert the double-buffer actually stacks (the
+# dht_ingest_pipeline_inflight_peak gauge reaches >=2 via the
+# deterministic stack probe, both pipeline series ride the proxy
+# /stats exposition), the always-on stage histograms keep advancing
+# with the device stage now measured at consume, and the identical
+# workload rerun at depth=1 (the exact pre-pipeline serial path)
+# returns the same values / listener deliveries / per-node storage.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.pipeline_smoke import main
+rc = main()
+assert rc == 0, "pipeline smoke failed"
+PY
+# wave-pipeline overlap smoke (round 20): sustained ingest through the
+# SHIPPING WaveBuilder at a small shape — depth-2 results must stay
+# bit-identical to depth-1, the in-flight machinery must hold two
+# waves (slow-ready shim), and the paired-delta band guards against
+# the pipeline REGRESSING sustained ingest (the committed
+# captures/pipeline_overlap.json documents the full-shape figure,
+# enforced against the README quote by check_docs above).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_pipeline_r20", pathlib.Path("benchmarks/exp_pipeline_r20.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "wave pipeline smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

@@ -44,6 +44,17 @@ real peer that close would answer with (model validated against the
 live protocol path at matched N, tests/test_hop_parity.py).  Replies
 are deterministic in (seed, round, search, slot) via a counter-based
 hash, so runs are reproducible and shardable.
+
+This module is the *simulation* engine (hop-count / convergence
+studies over the synthetic reply model).  The LIVE serving path's
+batched-resolve seam is ``runtime.dht.Dht.find_closest_nodes_launch``
+→ ``core.table.NodeTable.find_closest_launch`` →
+``core.table.Snapshot.lookup_launch`` — since round 20 every layer of
+that chain returns a launch handle (``core.table.PendingLookup`` /
+``runtime.dht.BatchedResolve``) whose ``consume()`` materializes the
+result, so ``runtime/wave_builder.py`` can keep ``ingest_pipeline_depth``
+≥ 2 waves in flight while the simulation engine here stays a
+synchronous whole-population ``lax.while_loop``.
 """
 
 from __future__ import annotations

@@ -88,6 +88,68 @@ class NodeView:
             now - self.time_reply < NODE_GOOD_TIME
 
 
+class PendingLookup:
+    """Handle for an in-flight (dispatched, not yet consumed) batched
+    closest-node resolve — the round-20 async seam.
+
+    JAX dispatch is asynchronous: the device kernel is launched when
+    ``lookup_launch``/``find_closest_launch`` returns, but the blocking
+    ``np.asarray`` transfer (and the host-side row mapping behind it)
+    is deferred into :meth:`consume`.  ``ready()`` is a non-blocking
+    probe (``jax.Array.is_ready``) so a caller — the wave-builder
+    pipeline — can fill and launch wave N+1 while wave N still runs on
+    device, and only pay the wait where the results are actually used.
+
+    The finalize closure must capture every piece of mutable host
+    state it maps through (churn-view ``delta_rows``/``_d_perm``, the
+    launch-time ``now``) AT LAUNCH TIME: the table may mutate between
+    launch and consume, and depth-1 equivalence requires the mapping
+    the synchronous path would have used.  Row→id/addr materialization
+    above this seam (``ids_of_rows``/``addr_of``) still reads the live
+    slab at consume; the one-pump window is sub-millisecond and an
+    eviction+row-reuse inside it resolves against the row's current
+    occupant — same class of benign race the synchronous path has
+    between resolve and RPC send.
+
+    ``consume()`` is idempotent (caches its result and drops the device
+    refs) so ``lookup(...) = lookup_launch(...).consume()`` is the ONE
+    codepath for both the synchronous and pipelined forms."""
+
+    __slots__ = ("_finalize", "_probe", "_done", "_result")
+
+    def __init__(self, finalize, probe=None):
+        self._finalize = finalize         # () -> result tuple
+        self._probe = probe               # device array or None (=ready)
+        self._done = False
+        self._result = None
+
+    @classmethod
+    def resolved(cls, *result):
+        """An already-materialized result (host-scan fast path)."""
+        pl = cls(None)
+        pl._done = True
+        pl._result = result if len(result) != 1 else result[0]
+        return pl
+
+    def ready(self) -> bool:
+        """Non-blocking: True when consume() will not wait on device."""
+        if self._done or self._probe is None:
+            return True
+        try:
+            return bool(self._probe.is_ready())
+        except AttributeError:            # numpy / stub result
+            return True
+
+    def consume(self):
+        """Block until the device work finishes, materialize, cache."""
+        if not self._done:
+            self._result = self._finalize()
+            self._done = True
+            self._finalize = None
+            self._probe = None
+        return self._result
+
+
 class Snapshot:
     """Immutable device view: lexicographically sorted ids + row map."""
 
@@ -124,16 +186,34 @@ class Snapshot:
         one device's HBM.  Exact either way; results identical (the
         window kernel's certificate decertifies into the shard-local
         full scan)."""
+        return self.lookup_launch(queries, k=k, window=window,
+                                  mesh=mesh).consume()
+
+    def lookup_launch(self, queries, *, k: int = TARGET_NODES,
+                      window: int = 128, mesh=None) -> PendingLookup:
+        """Async form of :meth:`lookup` (round-20 wave pipeline): the
+        device kernel is dispatched before this returns; the blocking
+        transfer + perm row-mapping are deferred into the handle's
+        ``consume()``.  The per-wave query buffer is donated to the
+        kernel when it is this call's own upload (non-CPU backends
+        only — see ops/sorted_table._donating_lookup_topk)."""
         q = jnp.asarray(queries, jnp.uint32)
         if mesh is not None and mesh.shape.get("t", 1) > 1:
-            return self._lookup_sharded(mesh, q, k, window)
+            return self._lookup_sharded_launch(mesh, q, k, window)
         if self._expanded is None:
             self._expanded = expand_table(self.sorted_ids)
         dist, idx, _ = lookup_topk(self.sorted_ids, self.n_valid, q, k=k,
-                                   expanded=self._expanded)
-        idx = np.asarray(idx)
-        rows = np.where(idx >= 0, np.asarray(self.perm)[np.clip(idx, 0, None)], -1)
-        return rows.astype(np.int32), np.asarray(dist)
+                                   expanded=self._expanded,
+                                   donate_queries=q is not queries)
+        perm = self.perm
+
+        def finalize(idx=idx, dist=dist, perm=perm):
+            idx = np.asarray(idx)         # blocks on the device call
+            rows = np.where(idx >= 0,
+                            np.asarray(perm)[np.clip(idx, 0, None)], -1)
+            return rows.astype(np.int32), np.asarray(dist)
+
+        return PendingLookup(finalize, probe=idx)
 
     def _shard_state(self, mesh):
         """Row-shard this snapshot's sorted slab over the mesh ``t``
@@ -167,16 +247,22 @@ class Snapshot:
         self._tp_state = (mesh, placed)
         return placed
 
-    def _lookup_sharded(self, mesh, q, k: int, window: int):
+    def _lookup_sharded_launch(self, mesh, q, k: int,
+                               window: int) -> PendingLookup:
         from ..parallel.sharded import sharded_window_lookup
         placed = self._shard_state(mesh)
         dist, gpos = sharded_window_lookup(
             mesh, q, placed["sorted_ids"], placed["perm"],
             placed["n_valid"], k=k, window=window)
-        gpos = np.asarray(gpos)
-        rows = np.where(gpos >= 0,
-                        np.asarray(self.perm)[np.clip(gpos, 0, None)], -1)
-        return rows.astype(np.int32), np.asarray(dist)
+        perm = self.perm
+
+        def finalize(gpos=gpos, dist=dist, perm=perm):
+            gpos = np.asarray(gpos)       # blocks on the collective
+            rows = np.where(gpos >= 0,
+                            np.asarray(perm)[np.clip(gpos, 0, None)], -1)
+            return rows.astype(np.int32), np.asarray(dist)
+
+        return PendingLookup(finalize, probe=gpos)
 
 
 class ChurnView:
@@ -304,6 +390,19 @@ class ChurnView:
         pack path the backend resolves ("auto" → 128//k on TPU, 1
         elsewhere); tombstone/delta gauges expose the view's churn
         debt."""
+        return self.lookup_launch(queries, k=k, window=window).consume()
+
+    def lookup_launch(self, queries, *, k: int = TARGET_NODES,
+                      window: int = 128) -> PendingLookup:
+        """Async form of :meth:`lookup` (round-20 wave pipeline).
+        Telemetry and the lazy tombstone/delta device refresh happen at
+        launch; the ``dht_churn_lookup_seconds`` histogram observes
+        dispatch + blocking-wait at consume (same device interval the
+        synchronous span covered).  The finalize closure captures
+        ``delta_rows``/``_d_perm``/``_perm`` AT LAUNCH: ``note_evict``
+        swap-removes delta slots in place and a delta re-sort replaces
+        ``_d_perm`` wholesale, so mapping through the live view at
+        consume could diverge from what this launch's kernel saw."""
         reg = telemetry.get_registry()
         reg.counter("dht_churn_lookups_total",
                     pack=_resolve_merge_pack("auto", k)).inc()
@@ -326,19 +425,30 @@ class ChurnView:
             self._d_perm = np.asarray(dp)
             self._dirty_delta = False
         ds, de, dnv = self._dev_delta
-        with reg.span("dht_churn_lookup_seconds"):
-            dist, enc, _ = churn_lookup_topk(
-                base.sorted_ids, base._expanded, base.n_valid,
-                self._dev_tomb, ds, de, dnv, q, k=k)
-            enc = np.asarray(enc)           # blocks on the device call
+        t0 = time.perf_counter()
+        dist, enc, _ = churn_lookup_topk(
+            base.sorted_ids, base._expanded, base.n_valid,
+            self._dev_tomb, ds, de, dnv, q, k=k)
+        dispatch_s = time.perf_counter() - t0
         n = base.sorted_ids.shape[0]
-        # enc in [n, n+D) is a *delta sorted position* → slot → slab row
-        dslot = self._d_perm[np.clip(enc - n, 0, len(self._d_perm) - 1)]
-        rows = np.where(
-            enc < 0, -1,
-            np.where(enc < n, self._perm[np.clip(enc, 0, n - 1)],
-                     self.delta_rows[np.clip(dslot, 0, None)]))
-        return rows.astype(np.int32), np.asarray(dist)
+        d_perm = self._d_perm
+        base_perm = self._perm
+        delta_rows = self.delta_rows.copy()
+        hist = reg.histogram("dht_churn_lookup_seconds")
+
+        def finalize(dist=dist, enc=enc):
+            t1 = time.perf_counter()
+            enc = np.asarray(enc)           # blocks on the device call
+            hist.observe(dispatch_s + (time.perf_counter() - t1))
+            # enc in [n, n+D) is a *delta sorted position* → slot → slab row
+            dslot = d_perm[np.clip(enc - n, 0, len(d_perm) - 1)]
+            rows = np.where(
+                enc < 0, -1,
+                np.where(enc < n, base_perm[np.clip(enc, 0, n - 1)],
+                         delta_rows[np.clip(dslot, 0, None)]))
+            return rows.astype(np.int32), np.asarray(dist)
+
+        return PendingLookup(finalize, probe=enc)
 
 
 class NodeTable:
@@ -868,23 +978,36 @@ class NodeTable:
         over its ``t`` axis (:meth:`Snapshot.lookup`) — the churn view
         and the host scan ignore it (identical results either way).
         """
+        return self.find_closest_launch(targets, k=k, now=now, mask=mask,
+                                        window=window, mesh=mesh).consume()
+
+    def find_closest_launch(self, targets, *, k: int = TARGET_NODES,
+                            now: Optional[float] = None,
+                            mask: str = "reachable", window: int = 128,
+                            mesh=None) -> PendingLookup:
+        """Async form of :meth:`find_closest` (round-20 wave pipeline):
+        returns a :class:`PendingLookup` whose device kernel is already
+        in flight; ``consume()`` blocks and maps rows.  The host-scan
+        fast path returns an already-resolved handle (``ready()`` is
+        immediately True — the live-protocol regime never defers)."""
         q = _as_limbs(targets)
         q = q.reshape(-1, IK.N_LIMBS)
         # truth flag for the spans/counters upstream: whether THIS
         # resolve actually ran the t-sharded kernel (the host scan and
         # the churn view ignore mesh) — read by
-        # Dht.find_closest_nodes_batched right after the call, same
+        # Dht.find_closest_nodes_launch right after the call, same
         # thread (the DHT loop is single-threaded)
         self.last_resolve_sharded = False
         if len(self) <= HOST_SCAN_MAX_ROWS \
                 and q.shape[0] <= HOST_SCAN_MAX_QUERIES:
-            return self._find_closest_host(q, k, now, mask)
+            return PendingLookup.resolved(
+                *self._find_closest_host(q, k, now, mask))
         view = self.view(now, mask=mask)
         if mesh is not None and mesh.shape.get("t", 1) > 1 \
                 and isinstance(view, Snapshot):
             self.last_resolve_sharded = True
-            return view.lookup(q, k=k, window=window, mesh=mesh)
-        return view.lookup(q, k=k, window=window)
+            return view.lookup_launch(q, k=k, window=window, mesh=mesh)
+        return view.lookup_launch(q, k=k, window=window)
 
     def _find_closest_host(self, q: np.ndarray, k: int,
                            now: Optional[float], mask: str):

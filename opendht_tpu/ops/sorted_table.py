@@ -871,10 +871,39 @@ def _lookup_topk_device(sorted_ids, expanded, n_valid, queries, lut, *,
     return out[1], out[0], jnp.ones_like(cert)
 
 
+_DONATING_LOOKUP = None
+
+
+def _donating_lookup_topk():
+    """The same compiled program as :func:`_lookup_topk_device` with the
+    per-wave query buffer donated (``donate_argnums=3`` — round-20 wave
+    pipeline: the wave builder uploads a fresh [Q,5] buffer per wave
+    and never re-reads it, so the backend may reuse its pages instead
+    of allocating per launch).  On the CPU backend donation is
+    unimplemented (and our query buffer never aliases the [Q,k,·]
+    outputs, so XLA would warn "donated buffers were not usable") —
+    there the plain jit is returned and the knob is a no-op."""
+    global _DONATING_LOOKUP
+    if _DONATING_LOOKUP is None:
+        if jax.default_backend() == "cpu":
+            _DONATING_LOOKUP = _lookup_topk_device
+        else:
+            import warnings
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            _DONATING_LOOKUP = jax.jit(
+                _lookup_topk_device.__wrapped__,
+                static_argnames=("k", "window", "select", "lut_steps",
+                                 "tile"),
+                donate_argnums=(3,))
+    return _DONATING_LOOKUP
+
+
 def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
                 fallback: bool = True, lut=None,
                 lut_steps=None, expanded=None,
-                select: str = "fast3", host_fallback: bool = False):
+                select: str = "fast3", host_fallback: bool = False,
+                donate_queries: bool = False):
     """Window lookup with exact fallback: uncertified queries re-run
     through the full-scan oracle so the result is always exact (when
     ``fallback=True``; with ``fallback=False`` rows where the returned
@@ -891,6 +920,11 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     the batch is huge, at the price of a blocking device→host sync per
     call.  Returns (dist [Q,k,5], idx [Q,k] int32 into the *sorted*
     table, certified [Q] bool).
+
+    ``donate_queries=True`` (round-20 wave pipeline) donates the query
+    buffer to the device-fallback jit — callers must pass a buffer they
+    own and never re-read (the wave builder's per-wave upload).  No-op
+    on CPU and on the host-fallback paths (which re-read ``queries``).
     """
     # Same OOM guard as the sharded shard-local fallback
     # (parallel/sharded.py): past 8M rows a 4096-row tile's [Q, 4104]x7
@@ -901,9 +935,11 @@ def lookup_topk(sorted_ids, n_valid, queries, *, k: int = 8, window: int = 128,
     n_rows = int(sorted_ids.shape[0])
     tile = max(1, min(4096 if n_rows <= 8_000_000 else 512, n_rows))
     if fallback and not host_fallback:
-        return _lookup_topk_device(sorted_ids, expanded, n_valid, queries,
-                                   lut, k=k, window=window, select=select,
-                                   lut_steps=lut_steps, tile=tile)
+        fn = _donating_lookup_topk() if donate_queries \
+            else _lookup_topk_device
+        return fn(sorted_ids, expanded, n_valid, queries,
+                  lut, k=k, window=window, select=select,
+                  lut_steps=lut_steps, tile=tile)
     if expanded is not None:
         dist, idx, cert = expanded_topk(sorted_ids, expanded, n_valid,
                                         queries, k=k, select=select,

@@ -186,7 +186,14 @@ def _spec_wave_builder():
     coalesced pump of live get/put/listen refills dispatches, vs the
     Q=1 padded launch each op used to pay.  Budgeted from day one so a
     refactor can't silently fatten the new hot path's device program
-    (the ISSUE-7 tentpole's cost-gate requirement)."""
+    (the ISSUE-7 tentpole's cost-gate requirement).
+
+    Round 20 note: the wave pipeline's buffer donation
+    (``ops.sorted_table._donating_lookup_topk``) is a runtime-only,
+    CPU-gated alias of the same jitted program — the lowered HLO this
+    budget pins is unchanged, so no re-base was needed when the
+    builder went async (the launch signature and canonical shape are
+    identical; donation only marks the query arg's buffer reusable)."""
     import jax
     from .ops.sorted_table import lookup_topk
     s, e, nv, lut = _canonical_table(_CANON["N"])

@@ -84,6 +84,13 @@ class Config:
     #: RateLimiter, the same sliding window the net engine's ingress
     #: quotas use); 0 = unlimited
     ingest_admit_per_sec: int = 0
+    #: pipeline depth (round 20): how many ingest waves may be in
+    #: flight on device at once.  2 (the default double-buffer) fills
+    #: wave N+1 and drains wave N−1's scatter while wave N runs on
+    #: device; 1 = exact pre-pipeline behavior (launch→block→scatter
+    #: inline, the escape hatch — pinned result-equivalent in
+    #: tests/test_wave_builder.py).  Validated ≥ 1 by WaveBuilder.
+    ingest_pipeline_depth: int = 2
 
     # --- t-sharded resolve (round 13, parallel/partition.py) ----------
     #: row-shard the device-side closest-node resolve over a t-wide

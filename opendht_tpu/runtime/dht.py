@@ -98,6 +98,45 @@ def _quota_key(addr: SockAddr) -> tuple:
     return (addr.family, addr.ip.packed if addr.ip else b"")
 
 
+class BatchedResolve:
+    """Handle for an in-flight batched closest-NODE resolve (round-20
+    wave pipeline) — the Node-materializing layer over
+    core/table.PendingLookup.  ``ready()`` probes without blocking;
+    ``consume()`` blocks on the device result and builds the
+    ``List[List[Node]]`` the synchronous entry point returns (it is
+    idempotent: ``find_closest_nodes_batched = launch().consume()``).
+    ``shard_t`` is the shard width of THIS launch, captured because the
+    shared ``Dht.last_resolve_shard_t`` may belong to a newer
+    overlapping wave by the time this one is consumed."""
+
+    __slots__ = ("shard_t", "_pending", "_finalize", "_done", "_result")
+
+    def __init__(self, finalize, pending=None, shard_t: int = 1):
+        self._finalize = finalize
+        self._pending = pending           # core PendingLookup or None
+        self.shard_t = int(shard_t or 1)
+        self._done = False
+        self._result = None
+
+    @classmethod
+    def resolved(cls, result, shard_t: int = 1) -> "BatchedResolve":
+        br = cls(None, shard_t=shard_t)
+        br._done = True
+        br._result = result
+        return br
+
+    def ready(self) -> bool:
+        return self._done or self._pending is None or self._pending.ready()
+
+    def consume(self) -> List[List[Node]]:
+        if not self._done:
+            self._result = self._finalize()
+            self._done = True
+            self._finalize = None
+            self._pending = None
+        return self._result
+
+
 class Dht:
     """A complete DHT node behind an injected datagram transport.
 
@@ -378,41 +417,57 @@ class Dht:
         requests (SURVEY.md §7 design mapping).  With a configured
         resolve mesh the device call is the t-sharded per-shard top-k +
         one cross-shard merge (core/table.py Snapshot.lookup)."""
+        return self.find_closest_nodes_launch(targets, af, count).consume()
+
+    def find_closest_nodes_launch(self, targets: List[InfoHash], af: int,
+                                  count: int = TARGET_NODES
+                                  ) -> BatchedResolve:
+        """Async form of :meth:`find_closest_nodes_batched` (round-20
+        wave pipeline): the device top-k is dispatched before this
+        returns; the handle's ``consume()`` blocks on the device and
+        materializes the Node lists.  ``handle.shard_t`` carries the
+        per-launch shard width — overlapping waves must not read the
+        shared ``last_resolve_shard_t`` at consume time."""
         # reset BEFORE any early return: a wave served by an empty
         # table (or one whose launch raises) must not inherit the
         # previous resolve's shard width (review finding)
         self.last_resolve_shard_t = 1
         table = self._table(af)
         if table is None or len(table) == 0 or not targets:
-            return [[] for _ in targets]
+            return BatchedResolve.resolved([[] for _ in targets])
         now = self.scheduler.time()
-        rows, _dist = table.find_closest(list(targets), k=count, now=now,
-                                         mesh=self.resolve_mesh())
+        pl = table.find_closest_launch(list(targets), k=count, now=now,
+                                       mesh=self.resolve_mesh())
         # truth, not config: the table says whether THIS resolve ran
         # sharded (host scans and churn views ignore the mesh) — the
         # ingest wave spans/counters attribute from this flag
-        self.last_resolve_shard_t = (
-            self.resolve_mesh_t()
-            if getattr(table, "last_resolve_sharded", False) else 1)
-        # one vectorized id conversion for the whole result matrix — the
-        # per-row numpy round-trip dominated big batches (table.py
-        # ids_of_rows)
-        ids_flat = table.ids_of_rows(rows)
-        out: List[List[Node]] = []
-        k_out = rows.shape[1]
-        for qi in range(rows.shape[0]):
-            nodes: List[Node] = []
-            for j in range(k_out):
-                r = rows[qi, j]
-                if r < 0:
-                    continue
-                addr = table.addr_of(int(r))
-                if addr is None:
-                    continue
-                nodes.append(self.engine.cache.get_node(
-                    ids_flat[qi * k_out + j], addr, now, confirm=False))
-            out.append(nodes)
-        return out
+        shard_t = (self.resolve_mesh_t()
+                   if getattr(table, "last_resolve_sharded", False) else 1)
+        self.last_resolve_shard_t = shard_t
+
+        def finalize():
+            rows, _dist = pl.consume()
+            # one vectorized id conversion for the whole result matrix —
+            # the per-row numpy round-trip dominated big batches
+            # (table.py ids_of_rows)
+            ids_flat = table.ids_of_rows(rows)
+            out: List[List[Node]] = []
+            k_out = rows.shape[1]
+            for qi in range(rows.shape[0]):
+                nodes: List[Node] = []
+                for j in range(k_out):
+                    r = rows[qi, j]
+                    if r < 0:
+                        continue
+                    addr = table.addr_of(int(r))
+                    if addr is None:
+                        continue
+                    nodes.append(self.engine.cache.get_node(
+                        ids_flat[qi * k_out + j], addr, now, confirm=False))
+                out.append(nodes)
+            return out
+
+        return BatchedResolve(finalize, pending=pl, shard_t=shard_t)
 
     def _searches_of(self, af: int) -> Dict[InfoHash, Search]:
         return self.searches.get(af, {})

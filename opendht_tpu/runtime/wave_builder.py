@@ -36,6 +36,23 @@ member):
   pre-round-12 path issued — pinned result-equivalent in
   tests/test_wave_builder.py and the burst-ingest CI smoke
   (testing/ingest_smoke.py).
+- **Depth-2+ wave pipeline** (round 20, ``ingest_pipeline_depth``):
+  wave N's ``[Q]`` launch is dispatched *asynchronously*
+  (``Dht.find_closest_nodes_launch`` — JAX async dispatch; the
+  blocking transfer is deferred into the handle's ``consume()``), so
+  the builder fills wave N+1 from the admission queue while N runs on
+  device and drains wave N−1's scatter fan-out from a dedicated
+  drainer job — host callback loops never sit between two launches.
+  A wave whose handle is already ready at launch time (the host-scan
+  regime: live-protocol tables) drains inline, so small-table latency
+  is exactly the depth-1 path's.  ``ingest_pipeline_depth=1`` is the
+  escape hatch (launch→block→scatter inline, the exact pre-round-20
+  behavior); depth 2+ is pinned bit-identical to depth 1 on results,
+  listener deliveries and exported storage
+  (tests/test_wave_builder.py, testing/pipeline_smoke.py).
+  In-flight waves are visible as the ``dht_ingest_pipeline_inflight``
+  gauge (+ ``_peak``) and the per-wave ``pipeline_slot`` attr on the
+  ``dht.search.wave`` ingest span.
 - Observability on the PR-3/PR-4/PR-6 spine: ``dht_ingest_queue_depth``
   gauge, ``dht_ingest_wave_occupancy`` / ``dht_ingest_queue_seconds`` /
   ``dht_ingest_wave_seconds`` histograms, shed/wave/op counters, a
@@ -111,6 +128,30 @@ class _Entry:
         self.cache_cb = cache_cb
 
 
+class _InflightWave:
+    """One dispatched-but-not-consumed wave (round-20 pipeline):
+    everything the drain step needs to scatter exactly as the
+    synchronous path would have — including the per-launch shard width
+    (on the handle) and the dispatch stamp/cost, so the waterfall's
+    device stage can be observed at consume."""
+
+    __slots__ = ("af", "k", "entries", "handle", "t_dispatch",
+                 "dispatch_s", "t_pick", "probe_s", "slot")
+
+    def __init__(self, af: int, k: int, entries: List[_Entry], handle,
+                 t_dispatch: float, dispatch_s: float, t_pick: float,
+                 probe_s: float, slot: int):
+        self.af = af
+        self.k = k
+        self.entries = entries
+        self.handle = handle          # runtime/dht.py BatchedResolve
+        self.t_dispatch = t_dispatch  # wall clock at dispatch
+        self.dispatch_s = dispatch_s  # host cost of the async dispatch
+        self.t_pick = t_pick          # wall clock at wave pickup
+        self.probe_s = probe_s        # cache-probe share of this wave
+        self.slot = slot              # waves already in flight at launch
+
+
 class WaveBuilder:
     """Fill-or-deadline-triggered aggregator over
     ``Dht.find_closest_nodes_batched`` (see module docstring)."""
@@ -125,13 +166,27 @@ class WaveBuilder:
         admit_qps = int(getattr(config, "ingest_admit_per_sec", 0) or 0)
         self._admit_limiter = (RateLimiter(admit_qps) if admit_qps > 0
                                else None)
+        # round 20: waves in flight on device at once; 1 = the exact
+        # pre-pipeline launch→block→scatter path (validated ≥ 1 here —
+        # a zero/negative knob silently falling back to 2 would hide a
+        # config typo behind the default)
+        self.pipeline_depth = max(1, int(
+            getattr(config, "ingest_pipeline_depth", 2) or 1))
         self._pending: deque = deque()
+        self._inflight: deque = deque()   # _InflightWave, oldest first
         self._job = None              # armed scheduler Job or None
+        self._drain_job = None        # armed drainer Job or None
         self._exempt = 0              # admission suspended (see exempt())
         self.waves = 0                # launches issued (cheap introspection)
+        self.inflight_peak = 0        # max concurrent in-flight waves seen
 
         reg = telemetry.get_registry()
         self._m_depth = reg.gauge("dht_ingest_queue_depth")
+        self._m_inflight = reg.gauge("dht_ingest_pipeline_inflight")
+        self._m_inflight_peak = reg.gauge("dht_ingest_pipeline_inflight_peak")
+        self._m_inflight.set(0)
+        self._m_inflight_peak.set(0)
+        self._m_wave_s = reg.histogram("dht_ingest_wave_seconds")
         self._m_occupancy = reg.histogram("dht_ingest_wave_occupancy")
         self._m_queue_s = reg.histogram("dht_ingest_queue_seconds")
         self._m_waves = reg.counter("dht_ingest_waves_total")
@@ -252,18 +307,32 @@ class WaveBuilder:
         scatter results.  Runs as a scheduler job on the DHT thread.
         Round 16: the hot-cache probe peels cache hits off the batch
         FIRST (one XOR-compare launch over the whole wave), so a hot
-        get never joins the ``[Q]`` lookup launch at all."""
+        get never joins the ``[Q]`` lookup launch at all.
+
+        Round 20, ``pipeline_depth >= 2``: launches are dispatched
+        asynchronously and queue on ``_inflight``; the only blocking
+        wait here is the backpressure bound (a full pipeline drains its
+        oldest wave before dispatching the next).  Waves that are
+        already ready at the end of the fire (host-scan resolves)
+        scatter inline — everything else is left to the drainer job, so
+        this fire returns to the runner loop with the device busy."""
         self._job = None
         if not self._pending:
             return
         batch = list(self._pending)
         self._pending.clear()
         self._m_depth.set(0)
+        wf = waterfall.get_profiler()
+        if self.pipeline_depth > 1:
+            # backpressure: never more than depth waves in flight — the
+            # oldest wave's scatter is paid here, while its successors
+            # keep the device busy
+            while len(self._inflight) >= self.pipeline_depth:
+                self._drain_one(wf)
         # waterfall (round 19): queue_wait = admission → wave pickup,
         # off the honest enqueue stamp (t_wall, see _Entry) — stamped
         # here, before the cache probe, so a cache-served op still
         # contributes its coalesce tax
-        wf = waterfall.get_profiler()
         t_pick = _time.time()
         if wf.enabled:
             for e in batch:
@@ -282,13 +351,24 @@ class WaveBuilder:
                 wf.observe("cache_probe", probe_s)
         else:
             batch = self._serve_cached(batch)
-        if not batch:
-            return
-        groups: dict = {}
-        for e in batch:
-            groups.setdefault((e.af, e.k), []).append(e)
-        for (af, k), entries in groups.items():
-            self._launch(af, k, entries, wf, t_pick, probe_s)
+        if batch:
+            groups: dict = {}
+            for e in batch:
+                groups.setdefault((e.af, e.k), []).append(e)
+            if self.pipeline_depth <= 1:
+                for (af, k), entries in groups.items():
+                    self._launch(af, k, entries, wf, t_pick, probe_s)
+                return
+            for (af, k), entries in groups.items():
+                self._launch_async(af, k, entries, wf, t_pick, probe_s)
+            # opportunistic same-pump drain: a wave whose handle is
+            # already materialized (host-scan resolve — the live
+            # protocol regime) scatters now, keeping small-table
+            # latency identical to depth 1.  Never blocks.
+            while self._inflight and self._inflight[0].handle.ready():
+                self._drain_one(wf)
+        if self._inflight:
+            self._arm_drain(self._dht.scheduler.time())
 
     def _serve_cached(self, entries: List[_Entry]) -> List[_Entry]:
         """The serve-from-cache fast path (ISSUE-11): ONE batched
@@ -328,6 +408,8 @@ class WaveBuilder:
     def _launch(self, af: int, k: int, entries: List[_Entry],
                 wf=None, t_pick: "float | None" = None,
                 probe_s: float = 0.0) -> None:
+        """Depth-1 wave: the exact pre-pipeline launch→block→scatter
+        path (``ingest_pipeline_depth=1``, the escape hatch)."""
         reg = telemetry.get_registry()
         if wf is None:
             wf = waterfall.get_profiler()
@@ -340,28 +422,131 @@ class WaveBuilder:
                 log.exception("ingest wave launch failed (af=%d k=%d Q=%d)",
                               af, k, len(entries))
                 results = None
-        t_launch_end = _time.time()
+        t_avail = _time.time()
         if results is None:
-            # a failed launch must not fail its carried (already
-            # admitted) searches on a transient device error: re-queue
-            # each entry for the next wave, up to _LAUNCH_RETRIES.  Only
-            # after the retries are spent does an entry scatter empty —
-            # a fresh search with no candidates then expires and fails
-            # its op honestly (persistent infrastructure failure, not
-            # backpressure).
-            reg.counter("dht_ingest_wave_failures_total").inc()
-            requeue = [e for e in entries if e.retries < _LAUNCH_RETRIES]
-            exhausted = [e for e in entries if e.retries >= _LAUNCH_RETRIES]
+            entries = self._requeue_failed(entries)
+            if not entries:
+                return
+            results = [[] for _ in entries]
+        shard_t = int(getattr(self._dht, "last_resolve_shard_t", 1) or 1)
+        self._scatter(af, k, entries, results, wf, t_pick, probe_s,
+                      t_fire, sp.elapsed, shard_t, t_avail, slot=0)
+
+    def _launch_async(self, af: int, k: int, entries: List[_Entry],
+                      wf, t_pick: float, probe_s: float) -> None:
+        """Depth-2+ wave: dispatch the ``[Q]`` launch and return with
+        the kernel in flight — the scatter belongs to the drainer."""
+        t_dispatch = _time.time()
+        try:
+            handle = self._dht.find_closest_nodes_launch(
+                [e.target for e in entries], af, k)
+        except Exception:
+            log.exception("ingest wave launch failed (af=%d k=%d Q=%d)",
+                          af, k, len(entries))
+            entries = self._requeue_failed(entries)
+            if entries:
+                # retries spent: scatter empty honestly, depth-1 style
+                self._scatter(af, k, entries, [[] for _ in entries], wf,
+                              t_pick, probe_s, t_dispatch, 0.0, 1,
+                              _time.time(), slot=len(self._inflight))
+            return
+        dispatch_s = max(0.0, _time.time() - t_dispatch)
+        self._inflight.append(_InflightWave(
+            af, k, entries, handle, t_dispatch, dispatch_s, t_pick,
+            probe_s, slot=len(self._inflight)))
+        n = len(self._inflight)
+        self._m_inflight.set(n)
+        if n > self.inflight_peak:
+            self.inflight_peak = n
+            self._m_inflight_peak.set(n)
+
+    # ------------------------------------------------------------- drain
+    def _arm_drain(self, t: float) -> None:
+        job = self._drain_job
+        if job is not None and not job.cancelled:
+            if job.time is not None and t < job.time:
+                self._drain_job = self._dht.scheduler.edit(job, t)
+        else:
+            self._drain_job = self._dht.scheduler.add(t, self._drain)
+
+    def _drain(self) -> None:
+        """Dedicated drainer step (round 20): scatter wave N−1's
+        fan-out OUTSIDE the fire that launches wave N, so host callback
+        loops never sit between two launches.  The sole in-flight wave
+        is only consumed when its handle is ready — otherwise the host
+        stays free to fill the next wave and the poll re-arms one
+        deadline out (a fresh fire's backpressure or inline drain may
+        well get there first)."""
+        self._drain_job = None
+        wf = waterfall.get_profiler()
+        while self._inflight:
+            if len(self._inflight) > 1 or self._inflight[0].handle.ready():
+                self._drain_one(wf)
+            else:
+                self._arm_drain(self._dht.scheduler.time() + self.deadline)
+                return
+
+    def _drain_one(self, wf) -> None:
+        w = self._inflight.popleft()
+        self._m_inflight.set(len(self._inflight))
+        t_wait0 = _time.time()
+        try:
+            results = w.handle.consume()
+        except Exception:
+            log.exception("ingest wave consume failed (af=%d k=%d Q=%d)",
+                          w.af, w.k, len(w.entries))
+            results = None
+        t_avail = _time.time()
+        # the waterfall device stage at consume: dispatch cost + the
+        # blocking wait actually paid here.  Host time the wave spent
+        # in flight between pumps is overlap, not device cost — it is
+        # visible as the wave span's wall duration instead.
+        dev_s = w.dispatch_s + max(0.0, t_avail - t_wait0)
+        self._m_wave_s.observe(dev_s)
+        entries = w.entries
+        if results is None:
+            entries = self._requeue_failed(entries)
+            if not entries:
+                return
+            results = [[] for _ in entries]
+        self._scatter(w.af, w.k, entries, results, wf, w.t_pick,
+                      w.probe_s, w.t_dispatch, dev_s,
+                      w.handle.shard_t, t_avail, slot=w.slot)
+
+    def _requeue_failed(self, entries: List[_Entry]) -> List[_Entry]:
+        """A failed launch must not fail its carried (already admitted)
+        searches on a transient device error: re-queue each entry for
+        the next wave, up to _LAUNCH_RETRIES, and return the exhausted
+        remainder (to scatter empty — a fresh search with no candidates
+        then expires and fails its op honestly: persistent
+        infrastructure failure, not backpressure)."""
+        telemetry.get_registry().counter(
+            "dht_ingest_wave_failures_total").inc()
+        requeue = [e for e in entries if e.retries < _LAUNCH_RETRIES]
+        exhausted = [e for e in entries if e.retries >= _LAUNCH_RETRIES]
+        if requeue:
             for e in requeue:
                 e.retries += 1
-                self._pending.append(e)
-            if requeue:
-                self._m_depth.set(len(self._pending))
-                self._arm(self._dht.scheduler.time() + self.deadline)
-            if not exhausted:
-                return
-            entries = exhausted
-            results = [[] for _ in entries]
+            # oldest-first (round-20 satellite fix): retried entries
+            # re-join AHEAD of anything submitted while the failed wave
+            # was in flight.  Appending them put a newer entry at
+            # _pending[0], whose t_enq anchors the deadline trigger
+            # (_arm in submit) — silently deferring the oldest op.
+            self._pending.extendleft(reversed(requeue))
+            self._m_depth.set(len(self._pending))
+            self._arm(self._dht.scheduler.time() + self.deadline)
+        return exhausted
+
+    def _scatter(self, af: int, k: int, entries: List[_Entry], results,
+                 wf, t_pick: "float | None", probe_s: float,
+                 t_dispatch: float, dev_elapsed: float, shard_t: int,
+                 t_avail: float, slot: int) -> None:
+        """Fan a wave's results out to the carried ops' callbacks, with
+        all the per-wave bookkeeping (metrics, keyspace, waterfall
+        stages, trace spans) — shared verbatim by the synchronous
+        depth-1 launch and the pipelined drain, so the two paths cannot
+        diverge.  ``t_avail`` is when results materialized (launch end
+        / consume end): the per-op scatter_back slices start there."""
         self.waves += 1
         self._m_waves.inc()
         # keyspace observatory (ISSUE-10): the wave's [Q] target ids
@@ -373,23 +558,25 @@ class WaveBuilder:
             ks.observe_hashes([e.target for e in entries])
         self._m_occupancy.observe(len(entries))
         for e in entries:
-            self._m_queue_s.observe(max(0.0, t_fire - e.t_wall))
-        # truth, not config: what the resolve ACTUALLY used — a wave
-        # served by the host scan or the churn view reports t=1 even
-        # when a resolve mesh is configured (Dht sets this right after
-        # the table call, same thread)
-        shard_t = int(getattr(self._dht, "last_resolve_shard_t", 1) or 1)
+            self._m_queue_s.observe(max(0.0, t_dispatch - e.t_wall))
+        # shard_t is truth, not config: what the resolve ACTUALLY used —
+        # a wave served by the host scan or the churn view reports t=1
+        # even when a resolve mesh is configured.  Carried per launch
+        # (BatchedResolve.shard_t / last_resolve_shard_t): overlapping
+        # waves must not read a shared flag at consume time.
         if shard_t > 1:
             self._m_sharded_waves.inc()
         # waterfall device stage: the first timed launch of an (af, k)
         # group carries XLA compilation — split so a one-time lowering
         # never poisons the serving p99 (host-side bookkeeping only;
-        # the launch itself is untouched)
+        # the launch itself is untouched).  With the pipeline this is
+        # observed at CONSUME (dispatch + blocking wait), where the
+        # device cost is actually known.
         dev_stage = "device_launch"
         if wf.enabled:
             dev_stage = ("device_compile" if wf.first_launch((af, k))
                          else "device_launch")
-            wf.observe(dev_stage, sp.elapsed,
+            wf.observe(dev_stage, dev_elapsed,
                        exemplar=next((e.ctx.trace_hex for e in entries
                                       if e.ctx is not None), None))
 
@@ -401,7 +588,7 @@ class WaveBuilder:
         # launch: tracing cannot perturb the kernel.
         tr = tracing.get_tracer()
         wave_ctx = None
-        wave_end = t_fire + sp.elapsed
+        wave_end = t_avail
         if tr.enabled and any(e.ctx is not None for e in entries):
             # round 13: device-cost attrs from the ledger's canonical
             # coalesced-launch entry, with per-device table traffic
@@ -410,10 +597,15 @@ class WaveBuilder:
             # hot path, same discipline as record_wave's wave_attrs)
             from .. import profiling
             cost = profiling.ingest_wave_attrs(len(entries), shard_t)
+            # the span covers dispatch → results materialized (for a
+            # pipelined wave that includes the in-flight overlap window
+            # — the wall truth); pipeline_slot = waves already in
+            # flight when this one launched (0 = head of the pipeline)
             wave_ctx = tr.record(
-                "dht.search.wave", t_fire, sp.elapsed,
+                "dht.search.wave", t_dispatch,
+                max(0.0, t_avail - t_dispatch),
                 mode="ingest", occupancy=len(entries), af=af, k=k,
-                table_shard_t=shard_t, **cost)
+                table_shard_t=shard_t, pipeline_slot=slot, **cost)
         for e, nodes in zip(entries, results):
             if wave_ctx is not None and e.ctx is not None:
                 # span covers submit → scatter, anchored on the entry's
@@ -433,19 +625,19 @@ class WaveBuilder:
                 # (admission → this op's scatter returned); rpc_wait
                 # overlaps the device stages and is deliberately absent
                 t_done = _time.time()
-                base = t_pick if t_pick is not None else t_fire
+                base = t_pick if t_pick is not None else t_dispatch
                 wf.record_op(e.kind, {
                     "queue_wait": max(0.0, base - e.t_wall),
                     "cache_probe": probe_s,
-                    dev_stage: sp.elapsed,
-                    "scatter_back": max(0.0, t_done - t_launch_end),
+                    dev_stage: dev_elapsed,
+                    "scatter_back": max(0.0, t_done - t_avail),
                 }, end_to_end=max(0.0, t_done - e.t_wall),
                     trace_id=e.ctx.trace_hex if e.ctx else None)
         if wf.enabled:
             # ONE scatter_back observation per wave (the whole fan-out
             # loop) — the per-op slices live in the records above
             wf.observe("scatter_back",
-                       max(0.0, _time.time() - t_launch_end))
+                       max(0.0, _time.time() - t_avail))
 
     # ---------------------------------------------------------- inspection
     def snapshot(self) -> dict:
@@ -460,6 +652,9 @@ class WaveBuilder:
             shard_t = 1
         return {
             "batching": "on" if self.enabled else "off",
+            "pipeline_depth": self.pipeline_depth,
+            "inflight": len(self._inflight),
+            "inflight_peak": self.inflight_peak,
             "table_shard_t": shard_t,
             "sharded_waves": int(self._m_sharded_waves.value),
             "fill_target": self.fill_target,

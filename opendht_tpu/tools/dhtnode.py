@@ -213,6 +213,10 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                           snap["batching"], snap["fill_target"],
                           snap["deadline_s"] * 1e3,
                           snap["queue_depth"], snap["queue_max"]))
+                print("pipeline depth %d  in-flight %d (peak %d)"
+                      % (snap.get("pipeline_depth", 1),
+                         snap.get("inflight", 0),
+                         snap.get("inflight_peak", 0)))
                 print("waves %d  occupancy mean %.2f p50 %.1f p95 %.1f"
                       % (snap["waves"], snap["occupancy_mean"],
                          snap["occupancy_p50"], snap["occupancy_p95"]))

@@ -18,10 +18,17 @@ slow, the always-on stage profiler says *why*.
   shape: XLA compilation rides that call, and folding it into
   ``device_launch`` would poison the p99 forever.  Split host-side by
   first-launch tracking — the kernels themselves are untouched.
-- ``device_launch`` — the timed ``block_until_ready`` span of every
-  subsequent ``find_closest_nodes_batched`` wave launch.
-- ``scatter_back`` — launch end → each op's scatter callback returned
-  (result fan-out + trace recording).
+- ``device_launch`` — the wave's device cost, measured AT CONSUME
+  since the round-20 pipeline: async dispatch cost + the blocking wait
+  actually paid when results are used (``BatchedResolve.consume``).
+  For ``ingest_pipeline_depth=1`` that collapses to the old timed
+  launch→block span of ``find_closest_nodes_batched``; at depth 2+ the
+  wave's host-overlap window (launch → drain pump) is deliberately NOT
+  device cost — it shows as the ``dht.search.wave`` span's wall
+  duration, and the in-flight count rides the
+  ``dht_ingest_pipeline_inflight`` gauge (+ ``_peak``).
+- ``scatter_back`` — results materialized → each op's scatter callback
+  returned (result fan-out + trace recording).
 - ``rpc_wait`` — network hop RTTs off the round-4 per-hop spans
   (``net/request.py`` completion; overlaps the device stages, so it is
   excluded from the per-op sum pin below).

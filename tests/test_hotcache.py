@@ -262,14 +262,17 @@ def test_disabled_cache_registers_no_series(monkeypatch):
 
 # ======================================================== Dht integration
 def spy_batched(dht):
+    # the launch seam covers both pipeline depths (round 20): the
+    # depth-1 sync path delegates to it and the pipeline dispatches
+    # through it directly — a cache-served get must skip BOTH
     calls = []
-    orig = dht.find_closest_nodes_batched
+    orig = dht.find_closest_nodes_launch
 
     def wrapper(targets, af, count=8):
         calls.append((len(targets), af, count))
         return orig(targets, af, count)
 
-    dht.find_closest_nodes_batched = wrapper
+    dht.find_closest_nodes_launch = wrapper
     return calls
 
 

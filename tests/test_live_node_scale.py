@@ -2,8 +2,10 @@
 
 A real ``Dht`` node with a table PAST the host-scan threshold
 (core/table.py HOST_SCAN_MAX_ROWS) must serve protocol requests through
-the device snapshot path — engine → Dht → NodeTable → Snapshot.lookup —
-and this is asserted, not assumed: every closest-node resolve during
+the device snapshot path — engine → Dht → NodeTable →
+Snapshot.lookup_launch (the round-20 launch/consume seam every resolve,
+sync or pipelined, funnels through) — and this is asserted, not
+assumed: every closest-node resolve during
 the burst is counted through the snapshot/churn view, and the snapshot
 version must match the table's.  benchmarks/live_node_scale.py is the
 full-scale driver (1M rows on the chip); this test runs the same stack
@@ -50,14 +52,17 @@ def test_live_node_serves_burst_through_device_path(monkeypatch):
     assert table._snap is not None
 
     calls = {"n": 0}
+    # lookup_launch is the one seam both the sync and the pipelined
+    # resolve forms share (lookup() itself delegates to it) — counting
+    # here covers the device path whatever ingest_pipeline_depth is
     for cls in (table_mod.Snapshot, table_mod.ChurnView):
-        orig = cls.lookup
+        orig = cls.lookup_launch
 
         def counted(self, queries, *, _orig=orig, **kw):
             calls["n"] += 1
             return _orig(self, queries, **kw)
 
-        monkeypatch.setattr(cls, "lookup", counted)
+        monkeypatch.setattr(cls, "lookup_launch", counted)
 
     stop = threading.Event()
 

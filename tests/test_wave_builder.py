@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import random
 import socket as _socket
+import time
 
 import numpy as np
 
@@ -46,16 +47,19 @@ def make_dht(clock, n_nodes=12, **cfg_kw):
 
 
 def spy_batched(dht):
-    """Wrap dht.find_closest_nodes_batched, recording (Q, af, k) per
-    underlying device resolve."""
+    """Wrap dht.find_closest_nodes_launch, recording (Q, af, k) per
+    underlying device resolve AT DISPATCH.  The launch seam is the one
+    both pipeline depths share: find_closest_nodes_batched (the depth-1
+    path) delegates to it, and the depth-2+ pipeline dispatches through
+    it directly."""
     calls = []
-    orig = dht.find_closest_nodes_batched
+    orig = dht.find_closest_nodes_launch
 
     def wrapper(targets, af, count=8):
         calls.append((len(targets), af, count))
         return orig(targets, af, count)
 
-    dht.find_closest_nodes_batched = wrapper
+    dht.find_closest_nodes_launch = wrapper
     return calls
 
 
@@ -276,10 +280,16 @@ def test_snapshot_surfaces_ingest_state():
     assert snap["waves"] >= 1
     assert snap["occupancy_mean"] >= 1.0
     assert snap["queue_depth"] == 0
+    # round 20: the pipeline state is part of the ops surface
+    assert snap["pipeline_depth"] == 2
+    assert snap["inflight"] == 0, "host-scan waves drain inline"
+    assert snap["inflight_peak"] >= 1
     # the series the proxy /stats route exports are registered
     prom = telemetry.get_registry().prometheus()
     for series in ("dht_ingest_queue_depth", "dht_ingest_wave_occupancy",
-                   "dht_ingest_queue_seconds", "dht_ingest_waves_total"):
+                   "dht_ingest_queue_seconds", "dht_ingest_waves_total",
+                   "dht_ingest_pipeline_inflight",
+                   "dht_ingest_pipeline_inflight_peak"):
         assert series in prom, series
 
 
@@ -299,8 +309,10 @@ def test_scanner_snapshot_has_ingest_section():
         for field in ("queue_depth", "queue_max", "waves",
                       "occupancy_p50", "occupancy_p95",
                       "queue_seconds_p95", "sheds", "fill_target",
-                      "deadline_s"):
+                      "deadline_s", "pipeline_depth", "inflight",
+                      "inflight_peak"):
             assert field in ing, field
+        assert ing["pipeline_depth"] == 2
     finally:
         r.join()
 
@@ -360,7 +372,7 @@ def test_failed_launch_requeues_then_exhausts():
     from opendht_tpu.runtime.wave_builder import _LAUNCH_RETRIES
     from opendht_tpu import telemetry
     fail = {"n": 0}
-    orig = dht.find_closest_nodes_batched
+    orig = dht.find_closest_nodes_launch
 
     def flaky(targets, af, count=8):
         if fail["n"] > 0:
@@ -368,7 +380,8 @@ def test_failed_launch_requeues_then_exhausts():
             raise RuntimeError("transient device error")
         return orig(targets, af, count)
 
-    dht.find_closest_nodes_batched = flaky
+    # the launch seam covers both pipeline depths (see spy_batched)
+    dht.find_closest_nodes_launch = flaky
     failures = telemetry.get_registry().counter(
         "dht_ingest_wave_failures_total")
     f0 = failures.value
@@ -398,6 +411,315 @@ def test_failed_launch_requeues_then_exhausts():
         dht.scheduler.run()
     assert got2 == [[]], got2
     assert dht.wave_builder.pending() == 0
+
+
+# ================================================== round 20: pipeline
+class _FakeHandle:
+    """Stand-in BatchedResolve with controllable readiness — lets the
+    tests hold a wave in flight deterministically (a real host-scan
+    resolve is ready the moment it is launched)."""
+
+    def __init__(self, results, *, ok=False, fail=False):
+        self._results = results
+        self.ok = ok
+        self.fail = fail
+        self.shard_t = 1
+
+    def ready(self):
+        return self.ok
+
+    def consume(self):
+        if self.fail:
+            raise RuntimeError("transient device error at consume")
+        return self._results
+
+
+def fake_launch(dht, *, ok=False, fail=False):
+    """Replace the launch seam with deferred fake handles; returns the
+    handle list for later readiness flips."""
+    handles = []
+
+    def launch(targets, af, count=8):
+        h = _FakeHandle([[] for _ in targets], ok=ok, fail=fail)
+        handles.append(h)
+        return h
+
+    dht.find_closest_nodes_launch = launch
+    return handles
+
+
+def _pump(dht, clock, dt=0.0025):
+    clock["t"] += dt
+    dht.scheduler.sync_time()
+    dht.scheduler.run()
+
+
+def test_pipeline_holds_two_waves_inflight_and_drains_fifo():
+    """The tentpole shape: wave N+1 fills and launches while wave N is
+    still on device (in-flight gauge peaks at the pipeline depth), and
+    the drainer scatters strictly oldest-first once results land."""
+    clock = {"t": 20_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002)
+    assert dht.wave_builder.pipeline_depth == 2
+    handles = fake_launch(dht)
+    reg = telemetry.get_registry()
+    got = []
+    roots = [tracing.TraceContext.new_root() for _ in range(4)]
+    for i, name in enumerate(("w1-a", "w1-b")):
+        with tracing.activate(roots[i]):
+            dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                    lambda nodes, n=name: got.append(n))
+    dht.scheduler.run()
+    assert len(handles) == 1 and got == [], "wave 1 must stay in flight"
+    assert dht.wave_builder.snapshot()["inflight"] == 1
+    for i, name in enumerate(("w2-a", "w2-b")):
+        with tracing.activate(roots[2 + i]):
+            dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                    lambda nodes, n=name: got.append(n))
+    _pump(dht, clock)
+    assert len(handles) == 2 and got == [], "wave 2 overlaps wave 1"
+    assert reg.snapshot()["gauges"]["dht_ingest_pipeline_inflight"] == 2
+    assert dht.wave_builder.inflight_peak == 2
+    for h in handles:
+        h.ok = True
+    _pump(dht, clock)
+    assert got == ["w1-a", "w1-b", "w2-a", "w2-b"], got
+    assert dht.wave_builder.snapshot()["inflight"] == 0
+    assert reg.snapshot()["gauges"]["dht_ingest_pipeline_inflight"] == 0
+    # the per-wave pipeline_slot attr: wave 1 launched into an empty
+    # pipeline (slot 0), wave 2 behind one in-flight wave (slot 1)
+    tr = tracing.get_tracer()
+    waves = [s for s in tr.dump()["spans"]
+             if s["name"] == "dht.search.wave"
+             and s["attrs"].get("mode") == "ingest"
+             and s["attrs"].get("occupancy") == 2]
+    slots = [s["attrs"].get("pipeline_slot") for s in waves[-2:]]
+    assert slots == [0, 1], slots
+
+
+def test_depth1_knob_is_exact_prepipeline_path():
+    """ingest_pipeline_depth=1 never defers: the wave launches and
+    scatters synchronously inside its fire, through the batched entry
+    point, with nothing in flight afterwards."""
+    clock = {"t": 21_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=5.0,
+                   ingest_pipeline_depth=1)
+    assert dht.wave_builder.pipeline_depth == 1
+    calls = spy_batched(dht)
+    got = []
+    for name in ("d1-a", "d1-b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append((n, nodes)))
+    dht.scheduler.run()
+    assert calls == [(2, AF, SEARCH_NODES)]
+    assert [n for n, _ in got] == ["d1-a", "d1-b"]
+    assert all(len(nodes) > 0 for _, nodes in got)
+    snap = dht.wave_builder.snapshot()
+    assert snap["pipeline_depth"] == 1 and snap["inflight"] == 0
+
+
+def test_depth_validated_ge_1():
+    clock = {"t": 21_500.0}
+    dht = make_dht(clock, ingest_pipeline_depth=0)
+    assert dht.wave_builder.pipeline_depth == 1
+    dht = make_dht(clock, ingest_pipeline_depth=-3)
+    assert dht.wave_builder.pipeline_depth == 1
+
+
+def test_depth2_results_identical_to_depth1_and_off():
+    """The bit-identity pin on the resolve surface: the same targets
+    through depth 2, depth 1 and batching off return identical node
+    rows in identical order."""
+    clock = {"t": 22_000.0}
+    targets = [InfoHash.get(f"d-eq-{i}") for i in range(5)]
+
+    def resolve(**cfg_kw):
+        dht = make_dht(clock, ingest_fill_target=5, ingest_deadline=5.0,
+                       **cfg_kw)
+        got = []
+        for t in targets:
+            dht.wave_builder.submit(t, AF, SEARCH_NODES,
+                                    lambda nodes: got.append(nodes))
+        dht.scheduler.run()
+        assert len(got) == 5
+        return [[n.id for n in row] for row in got]
+
+    r2 = resolve(ingest_pipeline_depth=2)
+    r1 = resolve(ingest_pipeline_depth=1)
+    roff = resolve(ingest_batching="off")
+    assert r2 == r1 == roff
+
+
+def test_virtualnet_put_get_equivalence_depth2_vs_depth1():
+    """End-to-end pin of the tentpole's non-negotiable: the same
+    virtual cluster + workload returns the same values, listener
+    deliveries and storers at pipeline depth 2 and depth 1 (the off
+    switch)."""
+    from opendht_tpu.testing.virtual_net import VirtualNet
+
+    def run(depth: int):
+        random.seed(99)
+        net = VirtualNet(seed=7)
+        cfg = lambda i: Config(  # noqa: E731
+            node_id=InfoHash.get(f"wb-pd-node-{i}"),
+            ingest_pipeline_depth=depth)
+        nodes = [net.add_node(cfg(i)) for i in range(6)]
+        for n in nodes[1:]:
+            net.bootstrap_node(n, nodes[0])
+        net.run(max_time=30.0)
+        key = InfoHash.get("wb-pd-key")
+        done = {}
+        heard = []
+        nodes[3].listen(key, lambda vals, exp:
+                        heard.extend(v.data for v in vals if not exp)
+                        or True)
+        net.run(max_time=30.0)
+        nodes[1].put(key, Value(b"wb-pipeline", value_id=7),
+                     lambda ok, ns: done.setdefault("put", ok))
+        net.run(max_time=30.0)
+        got = []
+        nodes[2].get(key, get_cb=lambda vals: got.extend(vals) or True,
+                     done_cb=lambda ok, ns: done.setdefault("get", ok))
+        net.run(max_time=30.0)
+        storers = sorted(bytes(d.myid).hex() for d in net.storers_of(key))
+        return (done, sorted(v.data for v in got), sorted(heard), storers)
+
+    done2, vals2, heard2, storers2 = run(2)
+    done1, vals1, heard1, storers1 = run(1)
+    assert done2.get("put") and done1.get("put")
+    assert vals2 == vals1 == [b"wb-pipeline"]
+    assert heard2 == heard1 == [b"wb-pipeline"]
+    assert storers2 == storers1
+
+
+def test_requeue_failed_restores_oldest_first():
+    """Round-20 satellite regression (wave_builder requeue ordering):
+    a failed launch re-queues its entries AHEAD of entries submitted
+    by an earlier group's scatter in the same fire — appending them
+    left a newer entry at _pending[0], whose t_enq anchors the
+    deadline trigger, silently deferring the oldest retried op."""
+    clock = {"t": 23_000.0}
+    dht = make_dht(clock, ingest_fill_target=64, ingest_deadline=0.002,
+                   ingest_pipeline_depth=1)
+    orig = dht.find_closest_nodes_launch
+    fail = {"k8": 1}
+
+    def flaky(targets, af, count=8):
+        if count == 8 and fail["k8"] > 0:
+            fail["k8"] -= 1
+            raise RuntimeError("transient device error")
+        return orig(targets, af, count)
+
+    dht.find_closest_nodes_launch = flaky
+    got = []
+    # group (AF, SEARCH_NODES) scatters first and submits a NEWER entry
+    # from its callback; group (AF, 8) then fails its launch
+    dht.wave_builder.submit(
+        InfoHash.get("rq-first"), AF, SEARCH_NODES,
+        lambda nodes: (got.append("first"), dht.wave_builder.submit(
+            InfoHash.get("rq-newer"), AF, SEARCH_NODES,
+            lambda n2: got.append("newer"))))
+    dht.wave_builder.submit(InfoHash.get("rq-oldest"), AF, 8,
+                            lambda nodes: got.append("oldest"))
+    _pump(dht, clock)
+    pend = list(dht.wave_builder._pending)
+    assert [e.target for e in pend] == \
+        [InfoHash.get("rq-oldest"), InfoHash.get("rq-newer")], \
+        "retried entry must re-join ahead of newer submissions"
+    assert pend[0].retries == 1
+    _pump(dht, clock)
+    assert sorted(got) == ["first", "newer", "oldest"]
+    assert dht.wave_builder.pending() == 0
+
+
+def test_mid_pipeline_consume_failure_requeues_without_drop_or_reorder():
+    """A launch failure mid-pipeline (wave N−1's consume raises while
+    wave N is in flight) re-queues wave N−1's entries oldest-first and
+    leaves wave N untouched — nothing dropped, nothing reordered."""
+    clock = {"t": 24_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002)
+    handles = fake_launch(dht)
+    reg = telemetry.get_registry()
+    failures = reg.counter("dht_ingest_wave_failures_total")
+    f0 = failures.value
+    got = []
+    for name in ("f1-a", "f1-b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    dht.scheduler.run()
+    for name in ("f2-a", "f2-b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    _pump(dht, clock)
+    assert len(handles) == 2
+    handles[0].fail = True            # wave 1 dies at consume
+    handles[1].ok = True              # wave 2 is fine
+    _pump(dht, clock)
+    # wave 2 scattered; wave 1's entries re-queued in submit order
+    assert got == ["f2-a", "f2-b"], got
+    assert failures.value == f0 + 1
+    pend = list(dht.wave_builder._pending)
+    assert [e.target for e in pend] == \
+        [InfoHash.get("f1-a"), InfoHash.get("f1-b")]
+    assert all(e.retries == 1 for e in pend)
+    # the retry wave (a fresh launch) delivers — no drop
+    for h in handles:
+        h.ok, h.fail = True, False
+    _pump(dht, clock)
+    for h in handles:
+        h.ok = True
+    _pump(dht, clock)
+    assert got == ["f2-a", "f2-b", "f1-a", "f1-b"], got
+    assert dht.wave_builder.pending() == 0
+
+
+def test_consume_retries_exhaustion_scatters_empty():
+    """_LAUNCH_RETRIES exhaustion through the pipelined consume path
+    still scatters empty honestly (the depth-1 twin lives in
+    test_failed_launch_requeues_then_exhausts)."""
+    from opendht_tpu.runtime.wave_builder import _LAUNCH_RETRIES
+    clock = {"t": 25_000.0}
+    dht = make_dht(clock, ingest_fill_target=64, ingest_deadline=0.002)
+    fake_launch(dht, ok=True, fail=True)   # every consume raises
+    got = []
+    dht.wave_builder.submit(InfoHash.get("exhaust-pd"), AF, SEARCH_NODES,
+                            lambda nodes: got.append(nodes))
+    for _ in range(_LAUNCH_RETRIES + 2):
+        _pump(dht, clock)
+    assert got == [[]], got
+    assert dht.wave_builder.pending() == 0
+    assert dht.wave_builder.snapshot()["inflight"] == 0
+
+
+def test_waterfall_stage_sum_holds_with_deferred_drain():
+    """Async dispatch keeps the waterfall's pinned invariant: for a
+    wave drained on a LATER pump than its launch, every per-op record's
+    stage sum stays ≤ end-to-end (the stages are disjoint sub-intervals
+    — device cost is dispatch + blocking wait, not the in-flight wall
+    window)."""
+    from opendht_tpu import waterfall
+    from opendht_tpu.waterfall import WaterfallConfig
+    wf = waterfall.get_profiler()
+    wf.configure(WaterfallConfig())
+    t0 = time.time()
+    clock = {"t": 26_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002)
+    handles = fake_launch(dht)
+    got = []
+    for name in ("wf-pd-a", "wf-pd-b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    dht.scheduler.run()
+    assert handles and got == []
+    handles[0].ok = True
+    _pump(dht, clock)
+    assert got == ["wf-pd-a", "wf-pd-b"]
+    recs = [o for o in wf.ops() if o["t"] >= t0]
+    assert len(recs) >= 2, recs
+    for o in recs[-2:]:
+        s = sum(o["stages"].values())
+        assert "rpc_wait" not in o["stages"]
+        assert s <= o["end_to_end"] + 1e-6, (s, o)
 
 
 def test_proxy_hotswap_resubscribe_exempt_from_admission():
