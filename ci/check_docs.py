@@ -492,6 +492,78 @@ def check_pipeline_overlap(failures):
                                 f"{which} device-stage mean {w} (±15%)")
 
 
+def check_reshard_balance(failures):
+    """Round-21 rule, BOTH directions: the committed load-aware
+    resharding artifact (``captures/reshard_balance.json``) must
+    itself record the acceptance — the Zipf(1.1) flood at t=4 reads
+    >2.0 imbalanced on the uniform split and <1.3 at the solved
+    traffic-weighted edges, with lookups bit-identical including a
+    wave in flight across the swap — and README *and* PARITY must
+    each carry a ``<!-- capture:reshard_balance -->``-tagged
+    paragraph quoting the measured before/after figures; a tagged
+    claim without the artifact (or vice versa) fails."""
+    cap_path = os.path.join(ROOT, "captures", "reshard_balance.json")
+    cap = None
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            cap = json.load(f)
+        t4 = cap.get("t4", {})
+        if not t4.get("imbalance_before", 0.0) > 2.0:
+            failures.append(
+                "captures/reshard_balance.json: t4 imbalance_before=%r "
+                "— the Zipf flood did not skew the uniform split past "
+                "2.0, so the capture proves nothing"
+                % t4.get("imbalance_before"))
+        if not t4.get("imbalance_after", 99.0) < 1.3:
+            failures.append(
+                "captures/reshard_balance.json: t4 imbalance_after=%r "
+                "— the solved boundaries left the load imbalanced"
+                % t4.get("imbalance_after"))
+        for tk in ("t2", "t4"):
+            sec = cap.get(tk, {})
+            if not sec.get("bit_identical"):
+                failures.append(
+                    "captures/reshard_balance.json: %s bit_identical is "
+                    "not true — the weighted layout diverged from the "
+                    "single-device engine" % tk)
+            if not sec.get("inflight_identical"):
+                failures.append(
+                    "captures/reshard_balance.json: %s "
+                    "inflight_identical is not true — a wave launched "
+                    "before the swap was remapped" % tk)
+    tag = "<!-- capture:reshard_balance -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if cap is None:
+            if tagged:
+                failures.append(f"{name}: '{tag}' claim with no "
+                                f"captures/reshard_balance.json artifact")
+            continue
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the resharding measurement")
+            continue
+        t4 = cap.get("t4", {})
+        want_before = "%.2f" % t4.get("imbalance_before", -1.0)
+        want_after = "%.2f" % t4.get("imbalance_after", -1.0)
+        for li in tagged:
+            para = _para_at(lines, li)
+            if want_before not in para:
+                failures.append(
+                    f"{name}: [capture:reshard_balance] paragraph does "
+                    f"not quote the measured {want_before} pre-swap "
+                    f"imbalance")
+            if want_after not in para:
+                failures.append(
+                    f"{name}: [capture:reshard_balance] paragraph does "
+                    f"not quote the measured {want_after} post-swap "
+                    f"imbalance")
+
+
 #: the observability index (ISSUE-10 satellite): every serving surface
 #: and the reference counterpart(s) it maps to.  BOTH directions: each
 #: surface must appear as a row of the tagged table in README AND
@@ -624,6 +696,7 @@ def main() -> int:
     check_overhead_captures(failures)
     check_swarm_storm(failures)
     check_pipeline_overlap(failures)
+    check_reshard_balance(failures)
     check_observability_index(failures)
     check_trajectory(failures)
     if failures:

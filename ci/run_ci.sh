@@ -270,6 +270,44 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "keyspace overhead smoke failed"
 PY
+# load-aware resharding smoke (round 21): boot a 3-node real-UDP
+# cluster + proxy, flood one hot key past the rebalance threshold, and
+# assert the closed loop live: a burst shorter than the sustain window
+# causes ZERO swaps (hysteresis skips advance, dhtmon --max-imbalance
+# exits 1), the sustained flood swaps a new layout generation (virtual
+# mode, reshard_swap flight event, dht_reshard_* on /stats), fold
+# attribution follows the new traffic-weighted edges (live imbalance
+# drops under the gate, dhtmon flips to 0), and get/put/listen are
+# identical across the swap.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.reshard_smoke import main
+rc = main()
+assert rc == 0, "reshard smoke failed"
+PY
+# reshard balance smoke (round 21): the boundary-solver benchmark at a
+# small shape — Zipf-hot traffic on the uniform split must read
+# imbalanced, the solved layout must refold balanced, the weighted
+# shard state must stay BIT-IDENTICAL to the single-device engine
+# (including an in-flight wave crossing the swap), and the committed
+# captures/reshard_balance.json quotes are enforced against README/
+# PARITY by check_docs above.
+python - <<'PY'
+import os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_reshard_r17", pathlib.Path("benchmarks/exp_reshard_r17.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke"])
+assert rc == 0, "reshard balance smoke failed"
+PY
 # hot-cache smoke (round 16): boot a 3-node real-UDP cluster + proxy
 # (node 0 caches, nodes 1-2 cache-off), Zipf-flood the hot key until
 # hot_key_emerged, and assert the observe→act loop closes live: the

@@ -163,7 +163,7 @@ class Snapshot:
         self._tp_state = None             # lazy (mesh, placed dict)
 
     def lookup(self, queries, *, k: int = TARGET_NODES, window: int = 128,
-               mesh=None):
+               mesh=None, layout=None):
         """Batched exact k-closest.  queries: uint32 [Q,5] (device or np).
         Returns (rows [Q,k] int32 numpy, dist [Q,k,5] numpy) with -1 padding.
 
@@ -185,12 +185,18 @@ class Snapshot:
         ``sharded_window_lookup``) — so the resolve table scales past
         one device's HBM.  Exact either way; results identical (the
         window kernel's certificate decertifies into the shard-local
-        full scan)."""
+        full scan).
+
+        ``layout`` (ISSUE-17, load-aware resharding): an installed
+        :class:`~opendht_tpu.reshard.ReshardLayout` moves the shard
+        boundaries to traffic-weighted row splits of THIS snapshot —
+        same merge kernel, same results, different ownership."""
         return self.lookup_launch(queries, k=k, window=window,
-                                  mesh=mesh).consume()
+                                  mesh=mesh, layout=layout).consume()
 
     def lookup_launch(self, queries, *, k: int = TARGET_NODES,
-                      window: int = 128, mesh=None) -> PendingLookup:
+                      window: int = 128, mesh=None,
+                      layout=None) -> PendingLookup:
         """Async form of :meth:`lookup` (round-20 wave pipeline): the
         device kernel is dispatched before this returns; the blocking
         transfer + perm row-mapping are deferred into the handle's
@@ -199,7 +205,7 @@ class Snapshot:
         only — see ops/sorted_table._donating_lookup_topk)."""
         q = jnp.asarray(queries, jnp.uint32)
         if mesh is not None and mesh.shape.get("t", 1) > 1:
-            return self._lookup_sharded_launch(mesh, q, k, window)
+            return self._lookup_sharded_launch(mesh, q, k, window, layout)
         if self._expanded is None:
             self._expanded = expand_table(self.sorted_ids)
         dist, idx, _ = lookup_topk(self.sorted_ids, self.n_valid, q, k=k,
@@ -215,17 +221,86 @@ class Snapshot:
 
         return PendingLookup(finalize, probe=idx)
 
-    def _shard_state(self, mesh):
+    def reshard_boundary_rows(self, layout, n_t: int):
+        """Traffic-weighted interior row boundaries of THIS snapshot
+        for an installed reshard layout — re-derived per snapshot (raw
+        row offsets go stale across rebuilds; the layout carries bin
+        loads, not rows), cached by ``(layout.gen, t)``.
+
+        Returns ``n_t - 1`` nondecreasing row indices into the valid
+        prefix of the sorted order (parallel/partition.py
+        ``solve_shard_boundaries``): the snapshot's per-bin row counts
+        come from one searchsorted over the sorted top limb."""
+        key = (int(layout.gen), int(n_t))
+        cached = getattr(self, "_reshard_rows", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from ..parallel.partition import solve_shard_boundaries
+        n = int(self.n_valid)
+        top = np.asarray(self.sorted_ids[:, 0]).astype(np.int64)
+        edges_v = np.arange(1, 256, dtype=np.int64) << 24
+        counts = np.searchsorted(top[:n], edges_v, side="left")
+        bin_rows = np.diff(np.concatenate([[0], counts, [n]]))
+        rows = solve_shard_boundaries(
+            bin_rows, layout.bin_loads, n_t,
+            load_weight=layout.load_weight)
+        self._reshard_rows = (key, rows)
+        return rows
+
+    def _shard_state(self, mesh, layout=None):
         """Row-shard this snapshot's sorted slab over the mesh ``t``
         axis ONCE (declarative placement — parallel/partition.py) and
         cache the placed operands; subsequent waves reuse them with
-        zero copies (the shard fns are placement-idempotent)."""
+        zero copies (the shard fns are placement-idempotent).
+
+        With a reshard ``layout`` (ISSUE-17) the split is the
+        traffic-weighted one: shard ``i`` owns rows
+        ``[b_i, b_{i+1})`` of the sorted order, physically realized as
+        equal-capacity slabs (rearranged rows + per-shard widths) so
+        ``P('t', None)`` placement still sees equal chunks.  The cache
+        key includes ``layout.gen`` — a hot swap is one attribute
+        write on the DHT loop; the NEXT wave rebuilds here (row
+        movement + placement, never a re-sort) while any wave already
+        in flight keeps the operands and perm map its launch captured.
+
+        Returns ``(placed, perm_host)``: ``perm_host`` is None for the
+        uniform split (global sorted positions map through
+        ``self.perm``) or the rearranged position→slab-row map for the
+        weighted one."""
         st = self._tp_state
-        if st is not None and st[0] is mesh:
-            return st[1]
+        key = (None if layout is None
+               else (int(layout.gen), int(mesh.shape["t"])))
+        if st is not None and st[0] is mesh and st[1] == key:
+            return st[2], st[3]
         from ..parallel import partition
         from ..parallel.sharded import pad_to_multiple
         n_t = mesh.shape["t"]
+        n = int(self.n_valid)
+        if layout is not None:
+            bnd = self.reshard_boundary_rows(layout, n_t)
+            bounds = np.maximum.accumulate(
+                np.concatenate([[0], np.clip(bnd, 0, n), [n]]))
+            widths = np.diff(bounds)
+            shard_cap = int(-(-max(int(widths.max()), 1)
+                              // partition.RESHARD_ALIGN)
+                            * partition.RESHARD_ALIGN)
+            ids_np = np.asarray(self.sorted_ids, np.uint32)
+            perm_np = np.asarray(self.perm)
+            ids_re = np.zeros((n_t * shard_cap, ids_np.shape[1]), np.uint32)
+            perm_host = np.full(n_t * shard_cap, -1, np.int32)
+            for i in range(n_t):
+                w = int(widths[i])
+                lo, hi = int(bounds[i]), int(bounds[i + 1])
+                ids_re[i * shard_cap:i * shard_cap + w] = ids_np[lo:hi]
+                perm_host[i * shard_cap:i * shard_cap + w] = perm_np[lo:hi]
+            nv = widths.astype(np.int32)
+            perm_local = np.tile(np.arange(shard_cap, dtype=np.int32), n_t)
+            placed = partition.shard_put(
+                mesh, {"sorted_ids": ids_re, "perm": perm_local,
+                       "n_valid": nv},
+                partition.TABLE_AXIS_RULES)
+            self._tp_state = (mesh, key, placed, perm_host)
+            return placed, perm_host
         cap = self.sorted_ids.shape[0]
         ids = self.sorted_ids
         if cap % n_t:
@@ -234,7 +309,6 @@ class Snapshot:
             # local n_valid, so their content never participates
             ids, _ = pad_to_multiple(np.asarray(ids), n_t)
         shard_n = ids.shape[0] // n_t
-        n = int(self.n_valid)
         nv = np.clip(n - np.arange(n_t) * shard_n, 0,
                      shard_n).astype(np.int32)
         # per-shard LOCAL sorted positions: the sharded kernel offsets
@@ -244,17 +318,19 @@ class Snapshot:
         placed = partition.shard_put(
             mesh, {"sorted_ids": ids, "perm": perm_local, "n_valid": nv},
             partition.TABLE_AXIS_RULES)
-        self._tp_state = (mesh, placed)
-        return placed
+        self._tp_state = (mesh, key, placed, None)
+        return placed, None
 
-    def _lookup_sharded_launch(self, mesh, q, k: int,
-                               window: int) -> PendingLookup:
+    def _lookup_sharded_launch(self, mesh, q, k: int, window: int,
+                               layout=None) -> PendingLookup:
         from ..parallel.sharded import sharded_window_lookup
-        placed = self._shard_state(mesh)
+        placed, perm_host = self._shard_state(mesh, layout)
         dist, gpos = sharded_window_lookup(
             mesh, q, placed["sorted_ids"], placed["perm"],
             placed["n_valid"], k=k, window=window)
-        perm = self.perm
+        # captured AT LAUNCH: a reshard swap between launch and consume
+        # must not remap this wave's positions through the new layout
+        perm = self.perm if perm_host is None else perm_host
 
         def finalize(gpos=gpos, dist=dist, perm=perm):
             gpos = np.asarray(gpos)       # blocks on the collective
@@ -960,7 +1036,7 @@ class NodeTable:
 
     def find_closest(self, targets, *, k: int = TARGET_NODES,
                      now: Optional[float] = None, mask: str = "reachable",
-                     window: int = 128, mesh=None):
+                     window: int = 128, mesh=None, layout=None):
         """k closest known peers for each target id
         (↔ RoutingTable::findClosestNodes, src/routing_table.cpp:109-150 —
         but batched over Q targets in one device call).
@@ -977,14 +1053,17 @@ class NodeTable:
         (``config.resolve_mesh_t``) row-shards the snapshot resolve
         over its ``t`` axis (:meth:`Snapshot.lookup`) — the churn view
         and the host scan ignore it (identical results either way).
+        A reshard ``layout`` (ISSUE-17) moves the sharded split to
+        traffic-weighted boundaries — same results, rebalanced load.
         """
         return self.find_closest_launch(targets, k=k, now=now, mask=mask,
-                                        window=window, mesh=mesh).consume()
+                                        window=window, mesh=mesh,
+                                        layout=layout).consume()
 
     def find_closest_launch(self, targets, *, k: int = TARGET_NODES,
                             now: Optional[float] = None,
                             mask: str = "reachable", window: int = 128,
-                            mesh=None) -> PendingLookup:
+                            mesh=None, layout=None) -> PendingLookup:
         """Async form of :meth:`find_closest` (round-20 wave pipeline):
         returns a :class:`PendingLookup` whose device kernel is already
         in flight; ``consume()`` blocks and maps rows.  The host-scan
@@ -1006,7 +1085,8 @@ class NodeTable:
         if mesh is not None and mesh.shape.get("t", 1) > 1 \
                 and isinstance(view, Snapshot):
             self.last_resolve_sharded = True
-            return view.lookup_launch(q, k=k, window=window, mesh=mesh)
+            return view.lookup_launch(q, k=k, window=window, mesh=mesh,
+                                      layout=layout)
         return view.lookup_launch(q, k=k, window=window)
 
     def _find_closest_host(self, q: np.ndarray, k: int,

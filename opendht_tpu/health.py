@@ -362,6 +362,28 @@ def _latch(active: bool, trip_burn: Optional[float],
     return trip_burn >= threshold
 
 
+def sustain_latch(since: Optional[float], now: float,
+                  value: Optional[float], threshold: float,
+                  recover: float) -> Optional[float]:
+    """Timestamped form of :func:`_latch` for raw gauges — the shared
+    sustain-window hysteresis rule (used by the reshard tick,
+    opendht_tpu/reshard.py, against ``dht_shard_imbalance``).
+
+    ``since`` is the time the value first exceeded ``threshold`` (None
+    = not latched).  Tripping needs ``value > threshold``; once
+    latched, clearing needs the value to fall below
+    ``threshold·recover`` — inside the hysteresis band the latch (and
+    its start time) holds, so a value oscillating around the threshold
+    accumulates ONE sustain window instead of restarting the clock at
+    every dip.  An unknown value keeps the previous state (same rule
+    as the SLO latch: no evidence is not recovery)."""
+    if value is None:
+        return since
+    if since is not None:
+        return None if value < threshold * recover else since
+    return now if value > threshold else None
+
+
 class HealthEvaluator:
     """The registry-reading verdict machine (see module docstring).
 

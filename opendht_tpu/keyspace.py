@@ -608,13 +608,30 @@ class KeyspaceObservatory:
         back (no snapshot yet, partially-filled table) folds over the
         uniform split and must say so, or the snapshot reports a
         uniform ring split as real per-shard loads (review
-        finding)."""
+        finding).
+
+        ``shard_info`` may return ``(t, bounds)`` or — since the
+        reshard plane (ISSUE-17) — ``(t, bounds, virtual)``, where
+        ``bounds`` is either boundary *ids* (uint limb rows) or
+        pre-folded fractional *bin edges* (floats, the virtual
+        resharded split).  Fold attribution always follows the edges
+        of the CURRENT layout: frames recorded before a swap keep the
+        values folded at their own tick (frames are immutable deltas),
+        later ticks attribute to the new ownership."""
         if self._shard_info is not None:
             try:
-                t, boundary_ids = self._shard_info()
+                info = self._shard_info()
+                t, bounds = info[0], info[1]
+                virtual = info[2] if len(info) > 2 else None
                 if t and t > 1:
-                    if boundary_ids is not None and len(boundary_ids):
-                        return t, bin_edges_from_ids(boundary_ids), False
+                    if bounds is not None and len(bounds):
+                        arr = np.asarray(bounds)
+                        if arr.dtype.kind == "f":
+                            edges = [float(x) for x in np.sort(arr)]
+                            return t, edges, (True if virtual is None
+                                              else bool(virtual))
+                        return t, bin_edges_from_ids(bounds), (
+                            False if virtual is None else bool(virtual))
                     return t, bin_edges_uniform(t), True
             except Exception:
                 log.debug("keyspace shard_info failed", exc_info=True)
@@ -648,6 +665,14 @@ class KeyspaceObservatory:
         if not self.enabled:
             return None
         return self._imbalance
+
+    def hist_window(self):
+        """Copy of the last published 256-bin windowed load histogram
+        (int64, top-8-bit key space) — the reshard tick's solver input
+        (opendht_tpu/reshard.py): boundaries are solved from the SAME
+        fold space the imbalance gauge measures in."""
+        with self._lock:
+            return np.array(self._hist_host, np.int64, copy=True)
 
     def top_keys(self) -> List[dict]:
         """Last tick's heavy hitters (key hex, windowed estimate,

@@ -190,6 +190,10 @@ TABLE_AXIS_RULES = (
     (r"sorted_ids$|^ids$|^table$|expanded$", P("t", None)),
     (r"local_lut$", P("t", None)),
     (r"block_lut$", P()),
+    # load-aware reshard geometry (ISSUE-17): per-shard (base, width)
+    # row ranges of a traffic-weighted split — a [t, 2] int32 operand,
+    # one row per shard, so boundary moves are data, never a recompile
+    (r"shard_rows$", P("t", None)),
     # `valid$` also covers the sketch twin's `sketch_valid` mask
     (r"perm$|valid$|n_local$|last_reply$", P("t")),
     # keyspace sketch traffic (ISSUE-10): the wave's observed ids split
@@ -223,6 +227,11 @@ class TableState(NamedTuple):
     shard_n: int
     lut_bits: int
     block_bits: int
+    #: interior row boundaries of a load-aware split (None = uniform
+    #: N/t rows per shard).  When set, ``arrays`` carries a
+    #: ``shard_rows`` [t, 2] operand and ``shard_n`` is the rounded-up
+    #: per-shard row CAPACITY, not the uniform width.
+    boundaries: Optional[tuple] = None
 
     @property
     def sorted_ids(self):
@@ -264,9 +273,43 @@ def _build_state_luts(mesh: Mesh, shard_n: int, lut_bits: int,
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=16)
+def _build_state_luts_weighted(mesh: Mesh, lut_bits: int, block_bits: int):
+    """Weighted-split twin of :func:`_build_state_luts`: each shard's
+    valid width comes from its ``shard_rows`` row instead of the
+    uniform ``n - ti*shard_n`` clip.  Because the (base, width) ranges
+    PARTITION the valid rows exactly, the psum of per-shard prefix LUTs
+    is still bit-identical to ``build_prefix_lut`` over the whole
+    table — the exactness argument never depended on equal widths."""
+    from ..ops.sorted_table import build_prefix_lut
+
+    def local(sorted_shard, shard_rows):
+        n_local = shard_rows[0, 1]
+        lut = build_prefix_lut(sorted_shard, n_local, bits=lut_bits)
+        part = (lut if block_bits == lut_bits else
+                build_prefix_lut(sorted_shard, n_local, bits=block_bits))
+        block_lut = lax.psum(part, "t")
+        return lut[None], block_lut
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("t", None), P("t", None)),
+        out_specs=(P("t", None), P()),
+        **SHARD_MAP_KW,
+    )
+    return jax.jit(fn)
+
+
+#: weighted shard capacities round up to a multiple of this, so a
+#: boundary nudge reuses the compiled kernels instead of recompiling
+#: for every new max-width
+RESHARD_ALIGN = 256
+
+
 def shard_table_state(mesh: Mesh, sorted_ids, n_valid, *,
                       lut_bits: Optional[int] = None,
-                      block_bits: Optional[int] = None) -> TableState:
+                      block_bits: Optional[int] = None,
+                      boundaries=None) -> TableState:
     """Split a GLOBALLY sorted id table over the mesh ``t`` axis and
     derive its lookup state — built ONCE per table, reused across every
     wave (``tp_simulate_lookups(..., state=)``).
@@ -279,10 +322,26 @@ def shard_table_state(mesh: Mesh, sorted_ids, n_valid, *,
     per-shard positioning LUT (default ``default_lut_bits(shard_n)``);
     ``block_bits`` the replicated global block LUT (default
     ``default_lut_bits(N)`` — it must match the single-device engine's
-    width for bit-identity, core/search.py ``_lut_block_bounds``)."""
+    width for bit-identity, core/search.py ``_lut_block_bounds``).
+
+    ``boundaries`` (ISSUE-17, load-aware resharding) is an optional
+    sequence of ``t-1`` interior row indices into the VALID prefix of
+    the sorted order (:func:`solve_shard_boundaries`).  Shard ``i``
+    then owns rows ``[b_i, b_{i+1})`` — still contiguous in the global
+    sort, just not equal-width.  Because ``P('t', None)`` placement
+    needs equal chunks per device, the weighted layout is physically
+    realized as a REARRANGED equal-capacity table: each shard's rows
+    are copied to the start of a ``shard_cap``-row slab (capacity =
+    max width rounded up to :data:`RESHARD_ALIGN`), and a ``shard_rows``
+    [t, 2] operand carries each shard's (base, width).  Reshard is row
+    movement + LUT rebuild — never a re-sort."""
     from ..ops.sorted_table import default_lut_bits
     N = sorted_ids.shape[0]
     n_t = mesh.shape["t"]
+    if boundaries is not None:
+        return _shard_table_state_weighted(
+            mesh, sorted_ids, n_valid, boundaries,
+            lut_bits=lut_bits, block_bits=block_bits)
     if N % n_t:
         raise ValueError(f"table rows ({N}) not divisible by t={n_t}; "
                          f"pad with invalid rows via pad_to_multiple")
@@ -304,3 +363,145 @@ def shard_table_state(mesh: Mesh, sorted_ids, n_valid, *,
         arrays={"sorted_ids": placed["sorted_ids"], "local_lut": local_lut,
                 "block_lut": block_lut, "n_valid": nv},
         shard_n=shard_n, lut_bits=lb, block_bits=bb)
+
+
+def _shard_table_state_weighted(mesh: Mesh, sorted_ids, n_valid, boundaries,
+                                *, lut_bits=None, block_bits=None):
+    from ..ops.sorted_table import default_lut_bits
+    N = int(sorted_ids.shape[0])
+    n_t = int(mesh.shape["t"])
+    n = int(n_valid)
+    ids_host = np.asarray(sorted_ids, np.uint32)
+    b = np.asarray(boundaries, np.int64).reshape(-1)
+    if b.shape[0] != n_t - 1:
+        raise ValueError(f"expected {n_t - 1} interior boundaries for "
+                         f"t={n_t}, got {b.shape[0]}")
+    bounds = np.concatenate([[0], np.clip(b, 0, n), [n]])
+    bounds = np.maximum.accumulate(bounds)
+    widths = np.diff(bounds)
+    shard_cap = int(-(-max(int(widths.max()), 1) // RESHARD_ALIGN)
+                    * RESHARD_ALIGN)
+    ids_re = np.zeros((n_t * shard_cap, ids_host.shape[1]), np.uint32)
+    for i in range(n_t):
+        w = int(widths[i])
+        ids_re[i * shard_cap:i * shard_cap + w] = (
+            ids_host[int(bounds[i]):int(bounds[i + 1])])
+    shard_rows = np.stack([bounds[:-1], widths], axis=1).astype(np.int32)
+    lb = lut_bits or default_lut_bits(shard_cap)
+    # block width stays keyed to the ORIGINAL table size: bit-identity
+    # with the single-device engine requires the same global LUT shape
+    # regardless of how the rows are cut
+    bb = block_bits or default_lut_bits(N)
+    placed = shard_put(mesh, {"sorted_ids": ids_re,
+                              "shard_rows": shard_rows}, TABLE_AXIS_RULES)
+    nv = jnp.asarray(n, jnp.int32)
+    local_lut, block_lut = _build_state_luts_weighted(mesh, lb, bb)(
+        placed["sorted_ids"], placed["shard_rows"])
+    return TableState(
+        arrays={"sorted_ids": placed["sorted_ids"], "local_lut": local_lut,
+                "block_lut": block_lut, "n_valid": nv,
+                "shard_rows": placed["shard_rows"]},
+        shard_n=shard_cap, lut_bits=lb, block_bits=bb,
+        boundaries=tuple(int(x) for x in bounds[1:-1]))
+
+
+# --------------------------------------------------------------------------
+# Load-aware boundary solver (ISSUE-17).  Pure numpy — it runs on the
+# node scheduler thread per rebalance tick, not on device.
+# --------------------------------------------------------------------------
+
+def _blend_bin_weights(meas, loads, load_weight):
+    """Per-bin weight: ``(1-λ)·rows/R + λ·loads/L``.  λ clips to
+    [0, 1]; a cold table (zero observed load) forces λ=0 so the solve
+    degrades to the row-uniform split."""
+    meas = np.asarray(meas, np.float64).reshape(-1)
+    if loads is None:
+        loads = np.zeros_like(meas)
+    else:
+        loads = np.asarray(loads, np.float64).reshape(-1)
+    if loads.shape != meas.shape:
+        raise ValueError(f"bin shapes differ: {meas.shape} vs {loads.shape}")
+    lam = min(max(float(load_weight), 0.0), 1.0)
+    L = float(loads.sum())
+    R = float(meas.sum())
+    if L <= 0.0:
+        lam = 0.0
+    w = np.zeros_like(meas)
+    if R > 0.0 and lam < 1.0:
+        w += (1.0 - lam) * meas / R
+    if lam > 0.0:
+        w += lam * np.clip(loads, 0.0, None) / L
+    return w
+
+
+def _solve_crossings(w, t):
+    """Interior equal-weight crossings of a per-bin weight profile.
+
+    Returns ``t-1`` pairs ``(bin, frac)``: crossing ``i`` sits at
+    fraction ``frac ∈ (0, 1]`` through ``bin`` — the first point where
+    cumulative weight reaches ``i/t`` of the total (weight is treated
+    as uniform WITHIN a bin, the same assumption ``keyspace.fold_bins``
+    makes when apportioning a straddled bin by overlap)."""
+    w = np.asarray(w, np.float64)
+    cumw = np.concatenate([[0.0], np.cumsum(w)])
+    W = float(cumw[-1])
+    out = []
+    for i in range(1, int(t)):
+        if W <= 0.0:
+            out.append((0, 0.0))
+            continue
+        T = W * i / float(t)
+        # first e with cumw[e] >= T; e >= 1 since cumw[0] = 0 < T
+        e = int(np.searchsorted(cumw, T, side="left"))
+        e = min(max(e, 1), len(w))
+        bin_ = e - 1
+        frac = (T - cumw[bin_]) / w[bin_] if w[bin_] > 0.0 else 1.0
+        out.append((bin_, float(min(max(frac, 0.0), 1.0))))
+    return out
+
+
+def solve_shard_boundaries(bin_rows, bin_loads, t, *, load_weight=1.0):
+    """Traffic-weighted split points, snapped to real row boundaries.
+
+    ``bin_rows[b]`` counts the sorted table's valid rows whose top id
+    byte is ``b`` (the same 256-bin space as the keyspace observatory's
+    load histogram ``bin_loads``).  Returns ``t-1`` nondecreasing row
+    indices in ``[0, n]``: boundary ``i`` is the SMALLEST row count r
+    such that the blended weight of rows ``[0, r)`` reaches ``i/t`` of
+    the total — each shard ``[b_i, b_{i+1})`` then carries ~equal
+    weighted traffic.  With ``load_weight=0`` (or a cold histogram)
+    this is the row-uniform split ``ceil(i·n/t)``."""
+    bin_rows = np.asarray(bin_rows, np.int64).reshape(-1)
+    n = int(bin_rows.sum())
+    w = _blend_bin_weights(bin_rows, bin_loads, load_weight)
+    row_start = np.concatenate([[0], np.cumsum(bin_rows)])
+    out = np.zeros(int(t) - 1, np.int64)
+    for i, (b, frac) in enumerate(_solve_crossings(w, t)):
+        r_b = int(bin_rows[b]) if b < bin_rows.shape[0] else 0
+        # within-bin row offset: smallest j with j/r_b >= frac (uniform
+        # weight within the bin ⇒ weight of j rows is frac·w_b at
+        # j = frac·r_b); the tiny eps keeps exact multiples from
+        # rounding up a row
+        j = int(np.ceil(frac * r_b - 1e-9)) if r_b > 0 else 0
+        out[i] = int(row_start[b]) + min(max(j, 0), r_b)
+    out = np.clip(out, 0, n)
+    return np.maximum.accumulate(out)
+
+
+def solve_shard_edges(bin_loads, t, *, load_weight=1.0, bin_rows=None):
+    """Fractional-bin-coordinate form of the solve, for VIRTUAL
+    attribution (no live mesh): returns ``t-1`` nondecreasing floats in
+    ``[0, bins]``, directly consumable by ``keyspace.fold_bins``.  The
+    cold measure defaults to a uniform ring (ones per bin), so a cold
+    table yields exactly ``keyspace.bin_edges_uniform(t)``."""
+    bin_loads = np.asarray(bin_loads, np.float64).reshape(-1)
+    meas = (np.ones_like(bin_loads) if bin_rows is None
+            else np.asarray(bin_rows, np.float64).reshape(-1))
+    w = _blend_bin_weights(meas, bin_loads, load_weight)
+    if float(w.sum()) <= 0.0:
+        bins = bin_loads.shape[0]
+        return np.asarray([bins * i / float(t) for i in range(1, int(t))],
+                          np.float64)
+    edges = np.asarray([b + frac for b, frac in _solve_crossings(w, t)],
+                       np.float64)
+    return np.maximum.accumulate(edges)

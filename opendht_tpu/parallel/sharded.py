@@ -333,7 +333,7 @@ def sharded_lookup(mesh: Mesh, queries, table, *, k: int = 8,
 @functools.lru_cache(maxsize=16)
 def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
                     alpha: int, search_nodes: int, max_hops: int,
-                    state_limbs: int = N_LIMBS):
+                    state_limbs: int = N_LIMBS, weighted: bool = False):
     """Compile the table-sharded iterative lookup for one geometry.
 
     Returns a jitted ``fn(sorted_ids, local_lut, block_lut, n_valid,
@@ -358,12 +358,26 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
     """
     q_local = q_total // mesh.shape["q"]
 
-    def local(sorted_shard, local_lut, block_lut, n_valid, targets_local,
-              seed):
-        ti = lax.axis_index("t")
-        base = (ti * shard_n).astype(jnp.int32)
-        n = jnp.asarray(n_valid, jnp.int32)
-        n_local = jnp.clip(n - base, 0, shard_n)
+    def local(*op):
+        if weighted:
+            # load-aware layout (ISSUE-17): each shard owns rows
+            # [base, base+width) of the global sorted order, carried as
+            # DATA in the [1, 2] shard_rows slice — the kernel text is
+            # identical for every boundary placement, so a hot swap
+            # never recompiles.  shard_n is the per-shard row CAPACITY
+            # (rows beyond the width are zero padding).
+            (sorted_shard, local_lut, block_lut, n_valid, shard_rows,
+             targets_local, seed) = op
+            base = shard_rows[0, 0]
+            n_local = shard_rows[0, 1]
+            n = jnp.asarray(n_valid, jnp.int32)
+        else:
+            (sorted_shard, local_lut, block_lut, n_valid, targets_local,
+             seed) = op
+            ti = lax.axis_index("t")
+            base = (ti * shard_n).astype(jnp.int32)
+            n = jnp.asarray(n_valid, jnp.int32)
+            n_local = jnp.clip(n - base, 0, shard_n)
         local_lower = _guarded_lower_bound(sorted_shard, n_local,
                                            local_lut[0])
         sorted_t = sorted_shard.T                        # [5, shard_n]
@@ -399,7 +413,11 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
             # psum site is gone — the engine reads the carried
             # candidate distance instead (core/search.py).
             flat = (rows - base).reshape(-1)
-            ok = (flat >= 0) & (flat < shard_n)
+            # ownership test: weighted shards own exactly n_local rows
+            # (the [b_i, b_{i+1}) ranges partition the valid prefix);
+            # the uniform test keeps the static width — equivalent for
+            # valid rows, and it leaves the uniform program unchanged
+            ok = (flat >= 0) & (flat < (n_local if weighted else shard_n))
             g = jnp.take(sorted_t[:limbs], jnp.clip(flat, 0, shard_n - 1),
                          axis=1)
             g = jnp.where(ok[None, :], g, _U32(0))
@@ -414,9 +432,12 @@ def build_tp_lookup(mesh: Mesh, shard_n: int, q_total: int, k: int,
                               max_hops=max_hops, state_limbs=state_limbs,
                               block_bounds=block_bounds)
 
+    in_specs = ((P("t", None), P("t", None), P(), P(), P("t", None),
+                 P("q", None), P()) if weighted else
+                (P("t", None), P("t", None), P(), P(), P("q", None), P()))
     fn = _shard_map(
         local, mesh=mesh,
-        in_specs=(P("t", None), P("t", None), P(), P(), P("q", None), P()),
+        in_specs=in_specs,
         out_specs={"nodes": P("q", None), "dist": P("q", None, None),
                    "hops": P("q"), "converged": P("q")},
         **_SM_KW,
@@ -477,13 +498,19 @@ def tp_simulate_lookups(mesh: Mesh, sorted_ids=None, n_valid=None,
     if Q % mesh.shape["q"]:
         raise ValueError(f"targets ({Q}) not divisible by q axis "
                          f"{mesh.shape['q']}")
+    a = state.arrays
+    weighted = "shard_rows" in a
     fn = build_tp_lookup(mesh, state.shard_n, Q, k, alpha, search_nodes,
-                         max_hops, state_limbs)
+                         max_hops, state_limbs, weighted)
     targets = shard_put(mesh, {"targets": _as_operand(targets, np.uint32)},
                         TABLE_AXIS_RULES)["targets"]
-    a = state.arrays
-    args = (a["sorted_ids"], a["local_lut"], a["block_lut"], a["n_valid"],
-            targets, jnp.asarray(seed, jnp.int32))
+    if weighted:
+        args = (a["sorted_ids"], a["local_lut"], a["block_lut"],
+                a["n_valid"], a["shard_rows"], targets,
+                jnp.asarray(seed, jnp.int32))
+    else:
+        args = (a["sorted_ids"], a["local_lut"], a["block_lut"],
+                a["n_valid"], targets, jnp.asarray(seed, jnp.int32))
     from .. import telemetry
     reg = telemetry.get_registry()
     if not reg.enabled:

@@ -462,6 +462,38 @@ def _spec_sharded_maintenance():
             {}, {"N": N, "mesh": "1x1", "buckets": 160})
 
 
+def _spec_reshard_state_build():
+    """The reshard hot-swap's device cost (ISSUE-17): the weighted
+    per-shard LUT rebuild (parallel/partition.py
+    _build_state_luts_weighted — per-shard prefix LUT + one psum for
+    the replicated global block LUT) on a 1×1 mesh, the only launch a
+    boundary swap adds (row movement is a host copy; there is never a
+    re-sort).  Budgeted so a refactor that turns the swap into a table
+    re-sort or fattens the rebuild's HBM traffic fails the gate."""
+    from jax.sharding import Mesh
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .ops.sorted_table import default_lut_bits
+    from .parallel import partition
+    s, _e, nv, _lut = _canonical_table(_CANON["N"])
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("q", "t"))
+    n = int(nv)
+    cap = int(-(-_CANON["N"] // partition.RESHARD_ALIGN)
+              * partition.RESHARD_ALIGN)
+    ids_re = np.zeros((cap, 5), np.uint32)
+    ids_re[:_CANON["N"]] = np.asarray(s, np.uint32)
+    shard_rows = np.asarray([[0, n]], np.int32)
+    placed = partition.shard_put(
+        mesh, {"sorted_ids": ids_re, "shard_rows": shard_rows},
+        partition.TABLE_AXIS_RULES)
+    fn = partition._build_state_luts_weighted(
+        mesh, default_lut_bits(cap), default_lut_bits(_CANON["N"]))
+    return (fn, (placed["sorted_ids"], placed["shard_rows"]), {},
+            {"N": _CANON["N"], "cap": cap, "mesh": "1x1",
+             "layout": "weighted"})
+
+
 #: name -> (builder, paired live telemetry series or None).  The series
 #: is the PR-3 histogram that times the SHIPPING launches of the same
 #: kernel, so exports can put the live p50 next to the canonical cost.
@@ -483,6 +515,8 @@ KERNEL_SPECS = {
         _spec_tp_simulate_lookups, 'dht_search_wave_seconds{mode="tp"}'),
     "sharded_window_lookup": (
         _spec_sharded_window_lookup, None),
+    "reshard_state_build": (
+        _spec_reshard_state_build, "dht_reshard_swap_seconds"),
     "sharded_maintenance_sweep": (
         _spec_sharded_maintenance,
         'dht_maintenance_sweep_seconds{mode="tp"}'),

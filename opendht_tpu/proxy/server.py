@@ -425,6 +425,17 @@ def _make_handler(server: DhtProxyServer):
                 # any internal failure — no second wrapper here
                 self._send_json(runner.get_cache())
                 return
+            if parts == ["reshard"]:
+                # GET /reshard → the load-aware resharding snapshot
+                # (ISSUE-17): layout generation + solved edges,
+                # tick/swap/reason-labeled skip counters, sustain latch
+                # age and post-swap refolded imbalance.  "reshard" is
+                # not a valid hash, so — like /stats — the path was
+                # previously a 400 and stays unambiguous.
+                # get_reshard already degrades to {"enabled": False} on
+                # any internal failure — no second wrapper here
+                self._send_json(runner.get_reshard())
+                return
             if parts == ["history"]:
                 # GET /history[?since=SEC][&limit=N] → the round-17
                 # flight data recorder's retained frames (delta-encoded

@@ -16,6 +16,7 @@ from ..history import HistoryConfig  # noqa: F401  (same knob-surface rule)
 from ..keyspace import KeyspaceConfig  # noqa: F401  (same knob-surface rule)
 from ..hotcache import HotCacheConfig  # noqa: F401  (same knob-surface rule)
 from ..waterfall import WaterfallConfig  # noqa: F401  (same knob-surface rule)
+from ..reshard import ReshardConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -184,6 +185,23 @@ class Config:
     #: ``waterfall.enabled = False`` stops observation entirely —
     #: results are identical either way (the profiler only observes).
     waterfall: WaterfallConfig = field(default_factory=WaterfallConfig)
+
+    # --- load-aware resharding (round 21, opendht_tpu/reshard.py) -----
+    #: the rebalance tick closing the observe→act loop on
+    #: ``dht_shard_imbalance``: when the windowed imbalance stays above
+    #: ``reshard.rebalance_threshold`` for ``reshard.sustain`` seconds
+    #: (hysteresis latch + history-frame corroboration; min-interval
+    #: cooldown), new traffic-weighted shard boundaries are solved from
+    #: the observatory's load histogram (blended with row counts by
+    #: ``rebalance_load_weight``) and hot-swapped under the serving
+    #: path between waves.  Lookup results are pinned bit-identical to
+    #: the single-device engine before, during and after a swap
+    #: (tests/test_reshard.py).  Surfaces: ``dht_reshard_*`` series,
+    #: `reshard_swap` flight events + trace spans, proxy
+    #: ``GET /reshard``, the ``reshard`` REPL cmd and the scanner
+    #: section.  ``reshard.period = 0`` (or ``enabled = False``)
+    #: disables the tick — the layout then never moves off uniform.
+    reshard: ReshardConfig = field(default_factory=ReshardConfig)
 
 
 @dataclass

@@ -245,6 +245,20 @@ class Dht:
             clock=self.scheduler.time)
         self.keyspace.subscribe(self.hotcache.on_keyspace_tick)
 
+        # load-aware resharding (round 21, ISSUE-17): the rebalance
+        # tick closing the loop on the observatory's imbalance gauge —
+        # sustained windowed imbalance above threshold solves new
+        # traffic-weighted shard boundaries and hot-swaps them under
+        # the serving path between waves (reshard.py; config.reshard
+        # knobs).  The runner late-binds the history ring for windowed
+        # frame corroboration (set_history).
+        from ..reshard import Resharder
+        self.reshard = Resharder(
+            getattr(config, "reshard", None), node=str(self.myid),
+            keyspace=self.keyspace, shard_t=self.resolve_mesh_t,
+            on_swap=self._reshard_apply, clock=self.scheduler.time)
+        self.reshard.attach(self.scheduler)
+
         # per-op latency waterfall (round 19, ISSUE-15): the always-on
         # stage profiler every serving layer feeds (wave builder,
         # search envelope, net engine/request) — process-global like
@@ -369,22 +383,61 @@ class Dht:
         m = self.resolve_mesh()
         return int(m.shape["t"]) if m is not None else 1
 
+    def _reshard_apply(self, layout) -> dict:
+        """Resharder swap hook, called inside the swap span with the
+        NEW layout before it is installed: when a mesh and a snapshot
+        are live, eagerly rebuild the snapshot's weighted shard state
+        (row movement + placement + per-shard perm map,
+        core/table.py ``Snapshot._shard_state``) so the next wave
+        doesn't pay the rebuild — the swap wall-clock histogram then
+        measures the real state-rebuild cost.  Runs on the DHT loop
+        (scheduler job), i.e. strictly between wave launches; waves
+        already in flight captured the OLD operands at launch."""
+        mesh = self.resolve_mesh()
+        if mesh is None:
+            return {"mode": "virtual"}
+        table = self._table(_socket.AF_INET)
+        snap = getattr(table, "_snap", None) if table is not None else None
+        if snap is None or int(snap.n_valid) < layout.t:
+            return {"mode": "virtual"}
+        snap._shard_state(mesh, layout)
+        return {"mode": "physical", "t": int(mesh.shape["t"])}
+
     def _keyspace_shard_info(self):
-        """(t, boundary_ids) for the keyspace observatory's per-shard
-        load attribution (ISSUE-10): when a resolve mesh is live, the
-        ACTUAL first-row ids of shards 1..t-1 of the current v4 table
-        snapshot (the row-sharded resolve splits the snapshot's cap
-        rows contiguously, core/table.py Snapshot._lookup_sharded) —
-        folding the traffic histogram over these is the real per-shard
-        load.  ``(0, None)`` when unsharded (the observatory falls back
-        to a uniform virtual split)."""
+        """(t, bounds[, virtual]) for the keyspace observatory's
+        per-shard load attribution (ISSUE-10): when a resolve mesh is
+        live, the ACTUAL first-row ids of shards 1..t-1 of the current
+        v4 table snapshot (the row-sharded resolve splits the
+        snapshot's cap rows contiguously, core/table.py
+        Snapshot._lookup_sharded) — folding the traffic histogram over
+        these is the real per-shard load.  ``(0, None)`` when unsharded
+        (the observatory falls back to a uniform virtual split).
+
+        With a reshard layout installed (ISSUE-17) the boundaries are
+        re-read from the CURRENT snapshot at the layout's solved split
+        — after a swap (or a snapshot rebuild) the fold attribution
+        follows the new edges immediately; frames recorded before the
+        swap keep the loads folded at their own tick.  Unsharded nodes
+        return the layout's fractional edges with ``virtual=True`` so
+        the virtual fold follows the resharded ownership too."""
+        lay = getattr(self, "reshard", None)
+        lay = lay.layout if lay is not None else None
         t = self.resolve_mesh_t()
         if t <= 1:
+            if lay is not None and lay.t > 1:
+                return lay.t, [float(e) for e in lay.edges], True
             return 0, None
         table = self._table(_socket.AF_INET)
         snap = getattr(table, "_snap", None) if table is not None else None
         if snap is None:
             return t, None
+        if lay is not None:
+            n_valid = int(snap.n_valid)
+            if n_valid >= t:
+                rows = np.asarray(
+                    snap.reshard_boundary_rows(lay, t), np.int64)
+                rows = np.clip(rows, 0, max(n_valid - 1, 0))
+                return t, np.asarray(snap.sorted_ids[rows]), False
         cap = snap.sorted_ids.shape[0]
         # mirror the actual split: _shard_state pads cap UP to a
         # multiple of t before slicing, so the per-shard row count is
@@ -436,8 +489,10 @@ class Dht:
         if table is None or len(table) == 0 or not targets:
             return BatchedResolve.resolved([[] for _ in targets])
         now = self.scheduler.time()
-        pl = table.find_closest_launch(list(targets), k=count, now=now,
-                                       mesh=self.resolve_mesh())
+        rs = getattr(self, "reshard", None)
+        pl = table.find_closest_launch(
+            list(targets), k=count, now=now, mesh=self.resolve_mesh(),
+            layout=rs.layout if rs is not None else None)
         # truth, not config: the table says whether THIS resolve ran
         # sharded (host scans and churn views ignore the mesh) — the
         # ingest wave spans/counters attribute from this flag

@@ -229,6 +229,13 @@ class DhtRunner:
                 hcfg, clock=dht.scheduler.time,
                 node=str(dht.get_node_id()))
             self._history.attach(dht.scheduler)
+            # the reshard tick's sustain check corroborates its latch
+            # against windowed frame evidence (reshard.py) — the ring
+            # is built here, after the Dht, so late-bind it
+            try:
+                dht.reshard.set_history(self._history)
+            except AttributeError:
+                pass
 
         # health observatory (round 14): the declarative SLO engine +
         # node verdict, evaluated on a periodic scheduler tick riding
@@ -972,6 +979,21 @@ class DhtRunner:
             if ks is None:
                 return {"enabled": False}
             return ks.snapshot()
+        except Exception:
+            return {"enabled": False}
+
+    def get_reshard(self) -> dict:
+        """The load-aware resharding snapshot (ISSUE-17): installed
+        layout generation + edges, tick/swap/skip counters (skips
+        reason-labeled), the sustain latch age and the post-swap
+        refolded imbalance — the JSON the proxy's ``GET /reshard``
+        route serves, the ``reshard`` REPL command prints, and the
+        scanner's ``reshard`` section embeds."""
+        try:
+            rs = getattr(self._dht, "reshard", None)
+            if rs is None:
+                return {"enabled": False}
+            return rs.snapshot()
         except Exception:
             return {"enabled": False}
 

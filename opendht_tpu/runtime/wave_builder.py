@@ -601,11 +601,17 @@ class WaveBuilder:
             # pipelined wave that includes the in-flight overlap window
             # — the wall truth); pipeline_slot = waves already in
             # flight when this one launched (0 = head of the pipeline)
+            # reshard generation serving this wave (0 = uniform split):
+            # across a hot swap the trace shows exactly which waves ran
+            # on which boundary generation
+            rs = getattr(self._dht, "reshard", None)
             wave_ctx = tr.record(
                 "dht.search.wave", t_dispatch,
                 max(0.0, t_avail - t_dispatch),
                 mode="ingest", occupancy=len(entries), af=af, k=k,
-                table_shard_t=shard_t, pipeline_slot=slot, **cost)
+                table_shard_t=shard_t, pipeline_slot=slot,
+                reshard_gen=(rs.layout.gen if rs is not None
+                             and rs.layout is not None else 0), **cost)
         for e, nodes in zip(entries, results):
             if wave_ctx is not None and e.ctx is not None:
                 # span covers submit → scatter, anchored on the entry's

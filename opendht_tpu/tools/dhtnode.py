@@ -49,6 +49,13 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            data the proxy serves on GET /keyspace;
                            'json' dumps the full snapshot (incl. the
                            256-bin histogram)
+    reshard [json]         load-aware resharding (round 21): installed
+                           boundary generation + solved edges,
+                           tick/swap/skip counters (skips labeled
+                           below-threshold / hysteresis / cooldown),
+                           sustain latch age and post-swap refolded
+                           imbalance — the same data the proxy serves
+                           on GET /reshard
     profile [json|folded]  per-op latency waterfall (round 19): per-
                            stage p50/p95/p99 (queue_wait, cache_probe,
                            device_compile/launch, scatter_back,
@@ -287,6 +294,41 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                             t_["estimate"], t_["share"] * 100))
                     if not snap["top"]:
                         print("  (no traffic observed yet)")
+            elif op == "reshard":
+                # load-aware resharding (ISSUE-17): same snapshot the
+                # proxy serves on GET /reshard
+                import json as _json
+                snap = node.get_reshard()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("resharding disabled")
+                else:
+                    lay = snap.get("layout")
+                    print("gen %d%s  ticks %d  swaps %d  threshold %.2f  "
+                          "sustain %.0fs  cooldown %.0fs" % (
+                              snap["gen"],
+                              " (%s)" % snap["mode"] if snap["mode"]
+                              else "",
+                              snap["ticks"], snap["swaps"],
+                              snap["threshold"], snap["sustain"],
+                              snap["min_interval"]))
+                    skips = snap.get("skips") or {}
+                    print("skips: %s" % (", ".join(
+                        "%s=%d" % kv for kv in sorted(skips.items()))
+                        or "none"))
+                    if snap.get("latched_s") is not None:
+                        print("imbalance above threshold for %.1fs"
+                              % snap["latched_s"])
+                    if lay is not None:
+                        print("layout t=%d edges %s  post-swap "
+                              "imbalance %s" % (
+                                  lay["t"], lay["edges"],
+                                  "%.3f" % snap["post_imbalance"]
+                                  if snap.get("post_imbalance")
+                                  is not None else "unknown"))
+                    else:
+                        print("layout: uniform (no swap yet)")
             elif op == "cache":
                 # hot-key serving cache (ISSUE-11): same snapshot the
                 # proxy serves on GET /cache

@@ -65,6 +65,7 @@ def topology_snapshot(node) -> dict:
         "health": {},
         "keyspace": {},
         "cache": {},
+        "reshard": {},
         "waterfall": {},
         "chaos": {},
         "events": [],
@@ -91,6 +92,14 @@ def topology_snapshot(node) -> dict:
         # shows WHERE in the ring traffic moved between snapshots (the
         # full 256-bin histogram rides along — it is 256 ints)
         snap["keyspace"] = node.get_keyspace()
+    except Exception:
+        pass
+    try:
+        # round-21 load-aware resharding: layout generation, solved
+        # edges and reason-labeled skip counters, so a soak diff shows
+        # WHEN the boundaries moved (next to the keyspace section's
+        # load attribution that triggered it)
+        snap["reshard"] = node.get_reshard()
     except Exception:
         pass
     try:

@@ -1,0 +1,575 @@
+"""Load-aware resharding (ISSUE-17): the traffic-weighted boundary
+solver vs a scalar per-row oracle (incl. degenerate histograms), the
+weighted shard state's bit-identity with the single-device engine, the
+Snapshot serving path's hot swap with in-flight waves pinned to the
+layout their launch captured, the Resharder state machine (sustain
+hysteresis, windowed frame counter-evidence, cooldown, reason-labeled
+skips), and the fold-attribution plumbing (keyspace ``_shard_edges``
+arities, ``Dht._keyspace_shard_info`` re-reading boundaries from the
+CURRENT snapshot after a swap)."""
+
+import socket as _socket
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from opendht_tpu.core.table import Snapshot
+from opendht_tpu.keyspace import (
+    BINS, KeyspaceConfig, KeyspaceObservatory, bin_edges_from_ids,
+    bin_edges_uniform, fold_bins, _imbalance,
+)
+from opendht_tpu.ops.sorted_table import sort_table
+from opendht_tpu.parallel import partition
+from opendht_tpu.parallel.partition import (
+    shard_table_state, solve_shard_boundaries, solve_shard_edges,
+)
+from opendht_tpu.parallel.sharded import make_mesh, tp_simulate_lookups
+from opendht_tpu.core.search import simulate_lookups
+from opendht_tpu.reshard import ReshardConfig, ReshardLayout, Resharder
+
+
+# ------------------------------------------------------------------ solver
+
+def _oracle_rows(bin_rows, bin_loads, t, load_weight):
+    """Scalar oracle: expand every bin into per-row weights (uniform
+    within the bin — the same assumption the solver and fold_bins
+    make), cumsum, and scan for the smallest row count whose weight
+    reaches i/t of the total."""
+    bin_rows = np.asarray(bin_rows, np.int64)
+    w = partition._blend_bin_weights(bin_rows, bin_loads, load_weight)
+    row_w = []
+    for b, r in enumerate(bin_rows):
+        if r > 0:
+            row_w.extend([w[b] / float(r)] * int(r))
+    cum = np.cumsum(np.asarray(row_w, np.float64))
+    W = float(cum[-1]) if cum.size else 0.0
+    n = int(bin_rows.sum())
+    out = []
+    for i in range(1, int(t)):
+        if W <= 0.0:
+            out.append(0)
+            continue
+        T = W * i / float(t)
+        r = 0
+        while r < n and cum[r] < T - 1e-9:
+            r += 1
+        out.append(r + 1 if r < n else n)
+    return np.maximum.accumulate(np.asarray(out, np.int64))
+
+
+def test_solver_matches_scalar_oracle_property():
+    """Randomized property sweep: solver == per-row oracle within one
+    row (the only slack is within-bin rounding), always nondecreasing,
+    always inside [0, n].  Loads are masked to OCCUPIED bins — weight
+    attributed to a row-less bin has no row to snap to, a case covered
+    separately below."""
+    rng = np.random.default_rng(29)
+    for trial in range(60):
+        bins = int(rng.integers(4, 24))
+        bin_rows = rng.integers(0, 9, size=bins).astype(np.int64)
+        loads = rng.integers(0, 101, size=bins).astype(np.int64)
+        loads[bin_rows == 0] = 0
+        t = int(rng.choice([2, 3, 4, 8]))
+        lam = float(rng.choice([0.0, 0.3, 0.9, 1.0]))
+        got = solve_shard_boundaries(bin_rows, loads, t, load_weight=lam)
+        want = _oracle_rows(bin_rows, loads, t, lam)
+        n = int(bin_rows.sum())
+        assert got.shape == (t - 1,), trial
+        assert np.all(np.diff(got) >= 0), (trial, got)
+        assert got.min() >= 0 and got.max() <= n, (trial, got, n)
+        assert np.all(np.abs(got - want) <= 1), \
+            (trial, got, want, bin_rows, loads, t, lam)
+
+
+def test_solver_cold_table_is_exact_uniform():
+    """Zero observed load (or load_weight=0) degrades EXACTLY to the
+    row-uniform split ceil(i*n/t) — the seed behavior, bit-for-bit."""
+    bin_rows = np.full(256, 64, np.int64)          # n = 16384
+    n = int(bin_rows.sum())
+    for t in (2, 3, 4, 8):
+        want = np.asarray([-(-n * i // t) for i in range(1, t)], np.int64)
+        cold = solve_shard_boundaries(bin_rows, np.zeros(256, np.int64), t)
+        assert np.array_equal(cold, want), t
+        lam0 = solve_shard_boundaries(
+            bin_rows, np.arange(256, dtype=np.int64), t, load_weight=0.0)
+        assert np.array_equal(lam0, want), t
+    # ragged n: ceil, not floor
+    ragged = np.zeros(8, np.int64)
+    ragged[:3] = [3, 3, 1]                          # n = 7
+    got = solve_shard_boundaries(ragged, np.zeros(8, np.int64), 4)
+    assert np.array_equal(got, [2, 4, 6])
+
+
+def test_solver_single_hot_bin_quarters_it():
+    """All load in one bin with λ=1: every interior boundary lands
+    INSIDE that bin's row range, splitting its rows ~equally."""
+    bin_rows = np.full(256, 64, np.int64)
+    loads = np.zeros(256, np.int64)
+    loads[10] = 5000
+    got = solve_shard_boundaries(bin_rows, loads, 4, load_weight=1.0)
+    lo, hi = 10 * 64, 11 * 64
+    assert np.array_equal(got, [lo + 16, lo + 32, lo + 48])
+    assert np.all((got > lo) & (got < hi))
+
+
+def test_solver_degenerate_histograms():
+    """Empty bins, load on a row-less bin, t > occupied bins, all load
+    in one shard's bins — monotone, in-range, never raises."""
+    # load attributed to a bin with zero rows: nothing to snap to —
+    # invariants still hold
+    bin_rows = np.zeros(16, np.int64)
+    bin_rows[[0, 15]] = [8, 8]
+    loads = np.zeros(16, np.int64)
+    loads[7] = 1000                                 # empty bin carries load
+    got = solve_shard_boundaries(bin_rows, loads, 4, load_weight=0.9)
+    assert np.all(np.diff(got) >= 0) and got.min() >= 0 and got.max() <= 16
+    # t greater than occupied bins: boundaries may repeat, stay ordered
+    bin_rows = np.zeros(256, np.int64)
+    bin_rows[[3, 200]] = [2, 2]
+    got = solve_shard_boundaries(
+        bin_rows, np.zeros(256, np.int64), 8, load_weight=1.0)
+    assert got.shape == (7,) and np.all(np.diff(got) >= 0)
+    assert got.max() <= 4
+    # all load inside what uniform would call one shard: λ=1 pulls
+    # every boundary into the hot range
+    bin_rows = np.full(64, 16, np.int64)
+    loads = np.zeros(64, np.int64)
+    loads[:8] = 100                                 # hot octant
+    got = solve_shard_boundaries(bin_rows, loads, 4, load_weight=1.0)
+    assert got.max() <= 8 * 16
+    # an entirely empty table: all boundaries 0
+    got = solve_shard_boundaries(
+        np.zeros(16, np.int64), np.zeros(16, np.int64), 4)
+    assert np.array_equal(got, [0, 0, 0])
+
+
+def test_solve_shard_edges_cold_and_hot():
+    """The fractional-edge form: cold == bin_edges_uniform exactly
+    (virtual attribution stays the seed split); a single hot bin at
+    λ=1 yields edges quartering that bin; refolding the histogram at
+    the solved edges balances the loads."""
+    for t in (2, 4, 8):
+        cold = solve_shard_edges(np.zeros(256, np.int64), t)
+        assert np.allclose(cold, bin_edges_uniform(t)), t
+    loads = np.zeros(256, np.int64)
+    loads[10] = 4000
+    edges = solve_shard_edges(loads, 4, load_weight=1.0)
+    assert np.allclose(edges, [10.25, 10.5, 10.75])
+    # closed loop: refold at solved edges -> near-perfect balance
+    loads = np.zeros(256, np.int64)
+    loads[:64] = 100
+    edges = solve_shard_edges(loads, 4, load_weight=0.9)
+    post = _imbalance(fold_bins(loads, list(edges)))
+    assert post is not None and post < 1.3
+    assert _imbalance(fold_bins(loads, bin_edges_uniform(4))) > 2.0
+
+
+# ------------------------------------------------ weighted state identity
+
+@pytest.mark.parametrize("t", [2, 4])
+def test_weighted_shard_state_bit_identical(t):
+    """The tentpole pin: a traffic-weighted shard_table_state (rows
+    moved to unequal ownership, per-shard LUTs, equal-capacity slabs)
+    drives tp_simulate_lookups to EXACTLY the single-device engine's
+    results — every output limb, every hop."""
+    rng = np.random.default_rng(17)
+    ids = rng.integers(0, 2 ** 32, size=(2048, 5), dtype=np.uint32)
+    sorted_ids, _, n_valid = sort_table(jnp.asarray(ids))
+    targets = rng.integers(0, 2 ** 32, size=(16, 5), dtype=np.uint32)
+    ref = simulate_lookups(sorted_ids, n_valid, jnp.asarray(targets), seed=9)
+
+    n = int(n_valid)
+    top = np.asarray(sorted_ids[:, 0]).astype(np.int64)
+    edges_v = np.arange(1, 256, dtype=np.int64) << 24
+    counts = np.searchsorted(top[:n], edges_v, side="left")
+    bin_rows = np.diff(np.concatenate([[0], counts, [n]]))
+    loads = np.zeros(256, np.int64)
+    loads[:32] = 1000                               # hot low ring
+    bnd = solve_shard_boundaries(bin_rows, loads, t, load_weight=0.9)
+    uniform = np.asarray([-(-n * i // t) for i in range(1, t)], np.int64)
+    assert not np.array_equal(bnd, uniform)         # genuinely skewed
+
+    mesh = make_mesh(t, q=1, t=t)
+    state = shard_table_state(mesh, np.asarray(sorted_ids), n_valid,
+                              boundaries=bnd)
+    assert state.boundaries is not None
+    assert "shard_rows" in state.arrays
+    out = tp_simulate_lookups(mesh, targets=targets, seed=9, state=state)
+    for key in ("nodes", "hops", "converged", "dist"):
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(ref[key]), err_msg=key)
+
+
+def _mk_snapshot(rng, n=1500):
+    ids = rng.integers(0, 2 ** 32, size=(n, 5), dtype=np.uint32)
+    sorted_ids, perm, n_valid = sort_table(jnp.asarray(ids))
+    return Snapshot(sorted_ids, np.asarray(perm), n_valid, 1, ("k", 0))
+
+
+def _hot_layout(gen, t, edges=(8.0,)):
+    loads = np.zeros(256, np.int64)
+    loads[:32] = 1000
+    return ReshardLayout(gen=gen, t=t, edges=tuple(edges),
+                         bin_loads=loads, load_weight=0.9)
+
+
+def test_snapshot_layout_serving_identity_and_inflight_pinning():
+    """The serving-path half of the tentpole: a Snapshot answers
+    IDENTICALLY unsharded, uniform-sharded, and reshard-layout-sharded
+    — and a hot swap between launch and consume leaves the in-flight
+    wave pinned to the operands + perm map its launch captured."""
+    rng = np.random.default_rng(23)
+    snap = _mk_snapshot(rng)
+    q = rng.integers(0, 2 ** 32, size=(8, 5), dtype=np.uint32)
+    ref_rows, ref_dist = snap.lookup(q)             # single-device path
+    mesh = make_mesh(2, q=1, t=2)
+    lay = _hot_layout(1, 2)
+
+    # the weighted boundary really moves ownership off the midpoint
+    n = int(snap.n_valid)
+    rows = np.asarray(snap.reshard_boundary_rows(lay, 2))
+    assert rows.shape == (1,) and int(rows[0]) != -(-n // 2)
+
+    # uniform sharded == unsharded
+    u_rows, u_dist = snap.lookup(q, mesh=mesh)
+    np.testing.assert_array_equal(u_rows, ref_rows)
+    np.testing.assert_array_equal(u_dist, ref_dist)
+
+    # in-flight pinning: launch against the uniform state, swap the
+    # layout in (rebuilds _tp_state + perm map), launch again — BOTH
+    # pending waves consume to the reference answer
+    pl_old = snap.lookup_launch(q, mesh=mesh)
+    pl_new = snap.lookup_launch(q, mesh=mesh, layout=lay)
+    for pl in (pl_old, pl_new):
+        got_rows, got_dist = pl.consume()
+        np.testing.assert_array_equal(got_rows, ref_rows)
+        np.testing.assert_array_equal(got_dist, ref_dist)
+
+    # steady state on the new layout, then a SECOND swap (gen bump,
+    # different histogram): still bit-identical
+    w_rows, w_dist = snap.lookup(q, mesh=mesh, layout=lay)
+    np.testing.assert_array_equal(w_rows, ref_rows)
+    np.testing.assert_array_equal(w_dist, ref_dist)
+    loads2 = np.zeros(256, np.int64)
+    loads2[200:232] = 500
+    lay2 = ReshardLayout(gen=2, t=2, edges=(216.0,),
+                         bin_loads=loads2, load_weight=0.9)
+    w2_rows, w2_dist = snap.lookup(q, mesh=mesh, layout=lay2)
+    np.testing.assert_array_equal(w2_rows, ref_rows)
+    np.testing.assert_array_equal(w2_dist, ref_dist)
+
+
+# ------------------------------------------------------- resharder machine
+
+class _KS:
+    """Scripted observatory stand-in."""
+
+    def __init__(self, virtual_shards=4):
+        self.imb = None
+        self.loads = np.zeros(256, np.int64)
+        self.loads[:64] = 100
+
+        class _Cfg:
+            pass
+
+        self.cfg = _Cfg()
+        self.cfg.virtual_shards = virtual_shards
+
+    def imbalance(self):
+        return self.imb
+
+    def hist_window(self):
+        return self.loads.copy()
+
+
+class _Frames:
+    enabled = True
+
+    def __init__(self, frames):
+        self._frames = frames
+
+    def frames(self, a, b):
+        return self._frames
+
+
+def _mk_resharder(ks=None, **cfg_kw):
+    cfg = ReshardConfig(period=0.0, rebalance_threshold=2.0, sustain=4.0,
+                        min_interval=10.0, recover_ratio=0.8, **cfg_kw)
+    clk = [0.0]
+    rs = Resharder(cfg, keyspace=ks if ks is not None else _KS(),
+                   shard_t=lambda: 0, clock=lambda: clk[0])
+    return rs, clk
+
+
+def test_resharder_full_sequence_swap_and_cooldown():
+    ks = _KS()
+    rs, clk = _mk_resharder(ks)
+    assert rs.tick()["reason"] == "below-threshold"  # imbalance unknown
+    ks.imb = 3.0
+    clk[0] = 1.0
+    assert rs.tick()["reason"] == "hysteresis"       # latch just armed
+    clk[0] = 3.0
+    assert rs.tick()["reason"] == "hysteresis"       # 2s < sustain 4s
+    clk[0] = 5.5
+    res = rs.tick()                                  # 4.5s sustained
+    assert res["action"] == "swap" and res["gen"] == 1
+    assert res["mode"] == "virtual" and res["t"] == 4
+    assert res["imbalance_after"] < 1.3              # refolded histogram
+    lay = rs.layout
+    assert lay is not None and lay.t == 4 and len(lay.edges) == 3
+    assert np.all(np.diff(lay.edges) > 0)
+    # post-swap the latch restarts: immediate re-trigger is hysteresis,
+    # then the cooldown holds even once sustain is met again
+    clk[0] = 6.0
+    assert rs.tick()["reason"] == "hysteresis"
+    clk[0] = 10.5
+    assert rs.tick()["reason"] == "cooldown"
+    clk[0] = 16.0
+    assert rs.tick()["gen"] == 2
+    snap = rs.snapshot()
+    assert snap["swaps"] == 2 and snap["ticks"] == 7
+    assert snap["skips"]["below-threshold"] == 1
+    assert snap["skips"]["hysteresis"] == 3
+    assert snap["skips"]["cooldown"] == 1
+    assert snap["layout"]["gen"] == 2
+
+
+def test_resharder_transient_burst_causes_zero_swaps():
+    """The ISSUE-17 hysteresis acceptance: a burst shorter than the
+    sustain window never swaps — the skip counter advances with
+    reason=hysteresis — and a later SUSTAINED overload does."""
+    ks = _KS()
+    rs, clk = _mk_resharder(ks)
+    ks.imb = 5.0
+    for now in (0.0, 1.0, 2.0):                      # 2s burst < 4s sustain
+        clk[0] = now
+        assert rs.tick()["reason"] == "hysteresis"
+    ks.imb = 1.0                                     # below thr*recover
+    for now in (3.0, 4.0):
+        clk[0] = now
+        assert rs.tick()["reason"] == "below-threshold"
+    snap = rs.snapshot()
+    assert snap["swaps"] == 0 and rs.layout is None
+    assert snap["skips"]["hysteresis"] == 3
+    # the latch fully reset: a new overload must sustain from scratch
+    ks.imb = 5.0
+    clk[0] = 5.0
+    assert rs.tick()["reason"] == "hysteresis"
+    clk[0] = 8.9
+    assert rs.tick()["reason"] == "hysteresis"       # 3.9s < 4s
+    clk[0] = 9.5
+    assert rs.tick()["action"] == "swap"
+
+
+def test_resharder_recover_band_holds_latch():
+    """Oscillation inside the hysteresis band (below threshold, above
+    threshold·recover_ratio) keeps the sustain clock running — the
+    dip skips as below-threshold but does not restart attribution."""
+    ks = _KS()
+    rs, clk = _mk_resharder(ks)
+    ks.imb = 3.0
+    clk[0] = 0.0
+    rs.tick()                                        # latch arms at 0
+    ks.imb = 1.9                                     # > 2.0*0.8 = 1.6
+    clk[0] = 2.0
+    assert rs.tick()["reason"] == "below-threshold"
+    ks.imb = 3.0
+    clk[0] = 4.5
+    assert rs.tick()["action"] == "swap"             # clock never reset
+
+
+def test_resharder_windowed_frame_counter_evidence():
+    """Frame samples inside the sustain window that dip below the
+    threshold (or go unknown, -1) refute the latch — windowed
+    evidence, not instants."""
+    ks = _KS()
+    rs, clk = _mk_resharder(ks)
+    ks.imb = 3.0
+    rs.set_history(_Frames([{"gauges": {"dht_shard_imbalance": 1.2}}]))
+    clk[0] = 0.0
+    rs.tick()
+    clk[0] = 4.5
+    res = rs.tick()
+    assert res["reason"] == "hysteresis" and res["window_min"] == 1.2
+    # unknown (-1) inside the window is counter-evidence too
+    rs.set_history(_Frames([{"gauges": {"dht_shard_imbalance": -1.0}}]))
+    clk[0] = 5.0
+    assert rs.tick()["reason"] == "hysteresis"
+    # corroborating frames let the swap through
+    rs.set_history(_Frames([{"gauges": {"dht_shard_imbalance": 2.7}}]))
+    clk[0] = 5.5
+    assert rs.tick()["action"] == "swap"
+    # an empty scan (delta-encoded frames: gauge unchanged) is NO
+    # counter-evidence — the latch alone decides
+    rs2, clk2 = _mk_resharder(_KS())
+    rs2.keyspace.imb = 3.0
+    rs2.set_history(_Frames([]))
+    clk2[0] = 0.0
+    rs2.tick()
+    clk2[0] = 4.5
+    assert rs2.tick()["action"] == "swap"
+
+
+def test_resharder_disabled_and_swap_error_keep_layout():
+    rs, clk = _mk_resharder(enabled=False)
+    assert rs.tick()["reason"] == "disabled"
+    assert rs.snapshot()["skips"]["disabled"] == 1
+
+    ks = _KS()
+    boom = {"n": 0}
+
+    def on_swap(layout):
+        boom["n"] += 1
+        if boom["n"] == 1:
+            raise RuntimeError("rebuild failed")
+        return {"mode": "physical"}
+
+    cfg = ReshardConfig(period=0.0, sustain=0.0, min_interval=0.0)
+    clk = [10.0]
+    rs = Resharder(cfg, keyspace=ks, shard_t=lambda: 0,
+                   on_swap=on_swap, clock=lambda: clk[0])
+    ks.imb = 3.0
+    res = rs.tick()
+    assert res == {"action": "skip", "reason": "error"}
+    assert rs.layout is None and rs.snapshot()["gen"] == 0
+    clk[0] = 11.0
+    res = rs.tick()                                  # next tick recovers
+    assert res["action"] == "swap" and res["mode"] == "physical"
+    assert rs.layout.gen == 1
+
+
+# --------------------------------------------------- attribution plumbing
+
+def test_keyspace_shard_edges_arities():
+    """_shard_edges accepts the legacy (t, ids) form and the reshard
+    (t, bounds, virtual) form; float bounds are pre-folded bin edges,
+    uint bounds are boundary ids."""
+    # float fractional edges + explicit virtual flag
+    obs = KeyspaceObservatory(
+        KeyspaceConfig(),
+        shard_info=lambda: (4, [10.5, 10.25, 10.75], True))
+    t, edges, virtual = obs._shard_edges()
+    assert (t, virtual) == (4, True)
+    assert edges == [10.25, 10.5, 10.75]             # sorted defensively
+    # legacy 2-tuple with boundary ids: virtual defaults False
+    ids = np.zeros((3, 5), np.uint32)
+    ids[:, 0] = [1 << 30, 2 << 30, 3 << 30]
+    obs = KeyspaceObservatory(KeyspaceConfig(), shard_info=lambda: (4, ids))
+    t, edges, virtual = obs._shard_edges()
+    assert (t, virtual) == (4, False)
+    assert edges == bin_edges_from_ids(ids)
+    # 3-tuple ids with virtual override (mesh fell back mid-rebuild)
+    obs = KeyspaceObservatory(KeyspaceConfig(),
+                              shard_info=lambda: (4, ids, True))
+    assert obs._shard_edges() == (4, bin_edges_from_ids(ids), True)
+    # (t, None) still folds over the uniform split, flagged virtual
+    obs = KeyspaceObservatory(KeyspaceConfig(), shard_info=lambda: (4, None))
+    assert obs._shard_edges() == (4, bin_edges_uniform(4), True)
+
+
+def _mk_dht(t=0):
+    from opendht_tpu.runtime.config import Config
+    from opendht_tpu.runtime.dht import Dht
+    from opendht_tpu.scheduler import Scheduler
+    cfg = Config(resolve_mesh_t=t) if t else Config()
+    return Dht(lambda data, addr: 0, config=cfg,
+               scheduler=Scheduler(), has_v6=False)
+
+
+def test_dht_shard_info_virtual_layout():
+    """An unsharded node with an installed layout attributes at the
+    layout's fractional edges (virtual=True) — the closed loop the
+    3-node smoke drives; without one, the seed (0, None)."""
+    dht = _mk_dht()
+    assert dht._keyspace_shard_info() == (0, None)
+    dht.reshard._layout = _hot_layout(1, 4, (10.25, 10.5, 10.75))
+    dht.reshard._gen = 1
+    t, edges, virtual = dht._keyspace_shard_info()
+    assert (t, virtual) == (4, True)
+    assert edges == [10.25, 10.5, 10.75]
+
+
+def test_dht_shard_info_rereads_boundaries_from_current_snapshot():
+    """Satellite (a): with a live mesh + layout, the boundary ids come
+    from the CURRENT snapshot's solved rows — a swap (or a snapshot
+    rebuild) moves the fold attribution immediately, and a snapshot
+    taken BEFORE the swap keeps the loads it folded at its own tick
+    (dict copies; frames are immutable deltas)."""
+    dht = _mk_dht(4)
+    cap = 1024
+    base = np.zeros((cap, 5), np.uint32)
+    base[:, 0] = (np.arange(cap, dtype=np.uint64)
+                  * (2 ** 32 // cap)).astype(np.uint32)
+    snap_a = Snapshot(jnp.asarray(base), np.arange(cap, dtype=np.int32),
+                      cap, 1, ("k", 0))
+    table = dht.tables[_socket.AF_INET]
+    table._snap = snap_a
+
+    # uniform seed behavior first (2-tuple, boundary rows 256/512/768)
+    t, ids = dht._keyspace_shard_info()
+    assert t == 4 and np.array_equal(np.asarray(ids), base[[256, 512, 768]])
+
+    # pre-swap observatory tick: skewed traffic folded at uniform edges
+    obs = KeyspaceObservatory(
+        KeyspaceConfig(tick=0, sample_stride=1, min_observed=1),
+        shard_info=dht._keyspace_shard_info)
+    hot = np.zeros((256, 5), np.uint32)
+    hot[:, 0] = np.asarray(
+        np.random.default_rng(31).integers(0, 2 ** 30, 256), np.uint32)
+    obs.observe_ids(hot)
+    obs.tick()
+    pre = obs.snapshot()["shards"]
+    assert pre["virtual"] is False and pre["imbalance"] > 2.0
+
+    # install a layout: boundaries re-read from the snapshot, skewed
+    dht.reshard._layout = _hot_layout(1, 4)
+    dht.reshard._gen = 1
+    t, ids, virtual = dht._keyspace_shard_info()
+    assert (t, virtual) == (4, False)
+    want_rows = np.clip(
+        np.asarray(snap_a.reshard_boundary_rows(dht.reshard._layout, 4),
+                   np.int64), 0, cap - 1)
+    assert np.array_equal(np.asarray(ids), base[want_rows])
+    assert not np.array_equal(want_rows, [256, 512, 768])
+
+    # post-swap tick follows the new edges; the pre-swap snapshot dict
+    # still carries the loads folded at ITS tick
+    obs.observe_ids(hot)
+    obs.tick()
+    post = obs.snapshot()["shards"]
+    assert post["imbalance"] < pre["imbalance"]
+    assert pre["imbalance"] > 2.0                    # unchanged copy
+
+    # a REBUILT snapshot (different id density) re-derives the rows
+    base_b = np.zeros((cap, 5), np.uint32)
+    base_b[:, 0] = (np.arange(cap, dtype=np.uint64) ** 2
+                    % (2 ** 32)).astype(np.uint32)
+    base_b = base_b[np.argsort(base_b[:, 0], kind="stable")]
+    snap_b = Snapshot(jnp.asarray(base_b), np.arange(cap, dtype=np.int32),
+                      cap, 2, ("k", 0))
+    table._snap = snap_b
+    t, ids_b, virtual = dht._keyspace_shard_info()
+    assert not np.array_equal(np.asarray(ids_b), np.asarray(ids))
+    want_b = np.clip(
+        np.asarray(snap_b.reshard_boundary_rows(dht.reshard._layout, 4),
+                   np.int64), 0, cap - 1)
+    assert np.array_equal(np.asarray(ids_b), base_b[want_b])
+
+
+def test_dht_wires_resharder_and_surfaces():
+    """The Dht builds a Resharder off Config.reshard, arms the tick on
+    the scheduler, and the snapshot surface carries the counters the
+    proxy / REPL / scanner expose."""
+    dht = _mk_dht()
+    assert dht.reshard is not None
+    assert dht.reshard.cfg.enabled is True
+    snap = dht.reshard.snapshot()
+    for key in ("enabled", "gen", "ticks", "swaps", "skips", "threshold",
+                "sustain", "min_interval", "load_weight", "layout"):
+        assert key in snap, key
+    assert snap["gen"] == 0 and snap["layout"] is None
+    # the periodic job is armed on the node scheduler
+    assert dht.reshard._sched is dht.scheduler
+    assert dht.reshard._job is not None
