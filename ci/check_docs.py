@@ -310,7 +310,7 @@ def check_tp_wire(failures):
 #: and both docs must state the bound
 _OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead",
                   "cache_overhead", "history_overhead",
-                  "waterfall_overhead")
+                  "waterfall_overhead", "pipeutil_overhead")
 
 
 def check_overhead_captures(failures):
@@ -564,6 +564,60 @@ def check_reshard_balance(failures):
                     f"imbalance")
 
 
+def check_pipeline_util(failures):
+    """Round-22 rule, BOTH directions: the committed observatory
+    overhead artifact (``captures/pipeutil_overhead.json``) must
+    itself record the tentpole invariant — a CLOSED ledger
+    (``accounting_closed``: Σ(busy) + Σ(bubbles) == observed window
+    on the timed trips) with at least one wave tracked per rep — and
+    README *and* PARITY must each carry a
+    ``<!-- capture:pipeutil_overhead -->``-tagged paragraph stating
+    that closed-accounting claim next to the measured quote (the
+    ``<1%`` bound itself rides the generic :func:`check_overhead_captures`
+    rule); a tagged claim without the artifact (or vice versa)
+    fails."""
+    cap_path = os.path.join(ROOT, "captures", "pipeutil_overhead.json")
+    cap = None
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            cap = json.load(f)
+        if not cap.get("accounting_closed"):
+            failures.append(
+                "captures/pipeutil_overhead.json: accounting_closed is "
+                "not true — the timed trips left an unclosed ledger "
+                "(Σ(busy) + Σ(bubbles) != observed window)")
+        if cap.get("waves_observed", 0) < cap.get("reps", 1):
+            failures.append(
+                "captures/pipeutil_overhead.json: waves_observed=%r "
+                "under reps=%r — the timed trips were not all tracked"
+                % (cap.get("waves_observed"), cap.get("reps")))
+    tag = "<!-- capture:pipeutil_overhead -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if cap is None:
+            if tagged:
+                failures.append(f"{name}: '{tag}' claim with no "
+                                f"captures/pipeutil_overhead.json "
+                                f"artifact")
+            continue
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the observatory overhead "
+                            f"measurement")
+            continue
+        for li in tagged:
+            para = _para_at(lines, li)
+            if "Σ(busy)" not in para or "Σ(bubbles)" not in para:
+                failures.append(
+                    f"{name}: [capture:pipeutil_overhead] paragraph "
+                    f"does not state the closed-ledger claim "
+                    f"(Σ(busy) + Σ(bubbles) == observed window)")
+
+
 #: the observability index (ISSUE-10 satellite): every serving surface
 #: and the reference counterpart(s) it maps to.  BOTH directions: each
 #: surface must appear as a row of the tagged table in README AND
@@ -571,9 +625,10 @@ def check_reshard_balance(failures):
 #: here — adding a surface without registering it fails CI.
 OBS_SURFACES = ("GET /stats", "GET /trace", "GET /healthz",
                 "GET /keyspace", "GET /cache", "GET /history",
-                "GET /debug/bundle", "GET /profile", "kernel ledger",
-                "dhtscanner --json")
-OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /")
+                "GET /debug/bundle", "GET /profile", "GET /pipeline",
+                "kernel ledger", "dhtscanner --json")
+OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /",
+                  "DhtRunner::loop_")
 
 
 def check_observability_index(failures):
@@ -697,6 +752,7 @@ def main() -> int:
     check_swarm_storm(failures)
     check_pipeline_overlap(failures)
     check_reshard_balance(failures)
+    check_pipeline_util(failures)
     check_observability_index(failures)
     check_trajectory(failures)
     if failures:

@@ -484,6 +484,44 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke"])
 assert rc == 0, "wave pipeline smoke failed"
 PY
+# pipeline-utilization smoke (round 22): boot a 3-node real-UDP
+# cluster + proxy at depth 2, drive a Zipf-skewed get flood, and
+# assert the utilization observatory measured it — the
+# dht_pipeline_occupancy gauge leaves unknown for a value in (0, 1]
+# consistent with the stage histograms (device-stage samples <= waves,
+# both > 0, busy <= window), GET /pipeline serves the snapshot and
+# ?fmt=trace the three-lane Perfetto doc, both pipeline-occupancy
+# series ride the proxy /stats exposition, a forced admission choke is
+# attributed as a queue_empty bubble, and dhtmon --min-occupancy exits
+# 0 below the measured gauge then 1 at an impossible floor.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.pipeline_util_smoke import main
+rc = main()
+assert rc == 0, "pipeline utilization smoke failed"
+PY
+# observatory overhead smoke (round 22): with the full per-wave
+# lifecycle (fill/dispatch/bubble-classify/device_done/scatter_done +
+# frame checkpoint) tracking every wave, the search round must stay
+# inside a generous 5% band vs the observatory-disabled run (the
+# committed captures/pipeutil_overhead.json documents the tight number
+# against the <1% acceptance, enforced against the README quote by
+# check_docs above), the wave outputs stay bit-identical on vs off,
+# and the timed trips must leave a CLOSED ledger
+# (Σ(busy)+Σ(bubbles)==window).
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_pipeutil_r21", pathlib.Path("benchmarks/exp_pipeutil_r21.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "observatory overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

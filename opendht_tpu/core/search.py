@@ -752,7 +752,7 @@ def record_wave(out, elapsed_s: float, wave_width: int, *,
     if wf.enabled:
         key = ("search", mode, int(wave_width))
         stage = ("device_compile" if wf.first_launch(key)
-                 else "device_launch")
+                 else "device_wait")
         wf.observe(stage, elapsed_s,
                    exemplar=tracing.current_trace_hex())
     if tr.enabled and ctx is not None:

@@ -204,6 +204,16 @@ DEFAULT_SIGNAL_THRESHOLDS = {
     # lowering).  Capped at degraded in the verdict (degrade_only): a
     # slow stage is an efficiency regression, not lost liveness.
     "stage_budget": (1.0, 2.0),
+    # round 22 (ISSUE-18): occupancy collapse — the pipeline
+    # observatory's windowed fraction of wall clock lost to STARVED
+    # device-idle bubbles (fill_slow / drain_backpressure /
+    # launch_retry / reshard_swap; queue_empty and cache_served are
+    # healthy idleness and never count).  Half the window starved
+    # degrades; 0.9 would be unhealthy-grade, but the signal is capped
+    # at degraded in the verdict (degrade_only): a starved pipeline is
+    # an efficiency collapse, not lost liveness.  Unknown (never
+    # trips) while the observatory is off or the window saw no waves.
+    "pipeline_occupancy": (0.5, 0.9),
 }
 
 
@@ -242,9 +252,11 @@ class HealthConfig:
     #: cache_hit_ratio rides the same cap (round 16): a cold or
     #: miss-heavy cache degrades efficiency, never liveness.
     #: stage_budget joins it (round 19): a stage past its latency
-    #: budget is slow serving, not a down node.
+    #: budget is slow serving, not a down node.  pipeline_occupancy
+    #: joins it (round 22): a starved pipeline serves slowly, it is
+    #: not dead.
     degrade_only: tuple = ("shard_imbalance", "cache_hit_ratio",
-                           "stage_budget")
+                           "stage_budget", "pipeline_occupancy")
 
 
 # ====================================================== window bookkeeping
@@ -731,6 +743,7 @@ class NodeHealth:
                 "shard_imbalance": self._shard_imbalance,
                 "cache_hit_ratio": self._cache_hit_ratio,
                 "stage_budget": self._stage_budget,
+                "pipeline_occupancy": self._pipeline_occupancy,
             })
         self._job = None
 
@@ -809,6 +822,22 @@ class NodeHealth:
         (:class:`HealthConfig`.degrade_only)."""
         from . import waterfall
         return waterfall.get_profiler().stage_budget()
+
+    def _pipeline_occupancy(self) -> Optional[float]:
+        """Occupancy collapse from the round-22 pipeline observatory:
+        windowed fraction of wall clock lost to STARVED device-idle
+        bubbles (the tick cadence IS the window, stage_budget-style).
+        Healthy idleness — queue_empty, cache_served — never counts,
+        so an idle node stays healthy and a flooded-but-starved one
+        degrades.  None (unknown, never trips) while the observatory
+        is off or the window saw no pipeline activity.  Degrade-only
+        in the verdict (:class:`HealthConfig`.degrade_only), with the
+        engine's standard hysteresis on recovery."""
+        wb = getattr(self._dht, "wave_builder", None)
+        obs = getattr(wb, "observatory", None)
+        if obs is None or not obs.enabled:
+            return None
+        return obs.collapse()
 
     # --------------------------------------------------------------- tick
     def attach(self, scheduler) -> None:

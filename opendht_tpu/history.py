@@ -163,6 +163,11 @@ class MetricsHistory:
         self._spill_seq = 0
         self._spill_failed = False
         self._job = None
+        # frame hooks (round 22): callables invoked with each committed
+        # frame, AFTER the ring append and outside the reader lock —
+        # the windowed-reset spine (pipeline observatory occupancy
+        # checkpoints, the wave builder's windowed in-flight peak)
+        self._frame_hooks: List[Callable[[dict], None]] = []
         # export handles (cached like the scheduler's)
         self._m_frames = self.reg.gauge("dht_history_frames",
                                         **({"node": node} if node else {}))
@@ -229,9 +234,25 @@ class MetricsHistory:
             # disk I/O OUTSIDE the lock: a slow disk must not stall the
             # scheduler thread against concurrent proxy/health readers
             self._write_segment(*spill_batch)
+        if frame is not None:
+            for fn in list(self._frame_hooks):
+                try:
+                    fn(frame)
+                except Exception:
+                    log.exception("history frame hook failed")
         self._m_frames.set(nframes)
         self._m_ticks.inc()
         return frame
+
+    def add_frame_hook(self, fn: Callable[[dict], None]) -> None:
+        """Register ``fn(frame)`` to run after every committed frame
+        (outside the reader lock, on the ticking thread).  This is the
+        recorder's windowed-reset cadence: per-frame windows elsewhere
+        (pipeline occupancy checkpoints, the windowed in-flight peak)
+        key off it instead of inventing their own timers.  Exceptions
+        are logged and swallowed — a broken hook must not stop the
+        flight recorder."""
+        self._frame_hooks.append(fn)
 
     def _delta_frame_locked(self, now: float, counters, gauges,
                             hists) -> dict:
@@ -516,6 +537,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
                  cache: Optional[dict] = None,
                  ingest: Optional[dict] = None,
                  waterfall: Optional[dict] = None,
+                 pipeline: Optional[dict] = None,
                  tracer: Optional[tracing.Tracer] = None,
                  flight_limit: int = 400) -> dict:
     """Assemble one post-mortem black-box bundle (↔ the reference's
@@ -538,6 +560,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
         "cache": cache or {},
         "ingest": ingest or {},
         "waterfall": waterfall or {},
+        "pipeline": pipeline or {},
         "history": {"enabled": False, "frames": []},
         "flight_recorder": {"spans": [], "events": []},
         "kernels": {},

@@ -499,6 +499,26 @@ def _make_handler(server: DhtProxyServer):
                 # on any internal failure — no second wrapper here
                 self._send_json(runner.get_profile())
                 return
+            if parts == ["pipeline"]:
+                # GET /pipeline → the pipeline utilization observatory
+                # (round 22, ISSUE-18): windowed device occupancy,
+                # per-cause bubble attribution, measured fill∥device
+                # overlap and the pipeline shape; ?fmt=trace serves
+                # the Perfetto lane export (one pid per fill/device/
+                # drain lane, waves as slices linked to their
+                # dht.search.wave spans).  "pipeline" is not a valid
+                # hash, so — like /profile — the path was previously a
+                # 400 and stays unambiguous.
+                fmt = (_q.get("fmt") or [None])[0]
+                if fmt == "trace":
+                    self._send_json(runner.get_pipeline_trace())
+                    return
+                if fmt is not None:
+                    self._err(400, "invalid fmt")
+                    return
+                # get_pipeline already degrades to {"enabled": False}
+                self._send_json(runner.get_pipeline())
+                return
             if parts[0] == "trace":
                 # GET /trace[?name=] → the node's flight-recorder dump
                 # (ISSUE-4; the reference's dumpTables as a scrapeable

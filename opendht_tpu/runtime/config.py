@@ -17,6 +17,7 @@ from ..keyspace import KeyspaceConfig  # noqa: F401  (same knob-surface rule)
 from ..hotcache import HotCacheConfig  # noqa: F401  (same knob-surface rule)
 from ..waterfall import WaterfallConfig  # noqa: F401  (same knob-surface rule)
 from ..reshard import ReshardConfig  # noqa: F401  (same knob-surface rule)
+from ..pipeline_observatory import PipelineObservatoryConfig  # noqa: F401,E501  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -202,6 +203,23 @@ class Config:
     #: section.  ``reshard.period = 0`` (or ``enabled = False``)
     #: disables the tick — the layout then never moves off uniform.
     reshard: ReshardConfig = field(default_factory=ReshardConfig)
+
+    # --- pipeline observatory (round 22, pipeline_observatory.py) -----
+    #: concurrency-aware utilization plane over the async wave
+    #: pipeline: per-wave lane timelines (fill / device / drain), the
+    #: windowed ``dht_pipeline_occupancy`` device-occupancy gauge,
+    #: per-cause ``dht_pipeline_bubble_seconds{cause=}`` device-idle
+    #: attribution (+ top-cause gauge), measured fill∥device overlap
+    #: (``dht_pipeline_overlap_ratio``) and a Perfetto lane export.
+    #: Surfaces: ``GET /pipeline`` (+ ``?fmt=trace``), the ``pipeline``
+    #: REPL cmd, the scanner's ``pipeline`` section, ``dhtmon
+    #: --min-occupancy`` and the degrade-only ``pipeline_occupancy``
+    #: health signal.  Host-side edge bookkeeping only — kernels and
+    #: results are bit-identical with the plane on
+    #: (tests/test_pipeline_observatory.py).  ``pipeline.enabled =
+    #: False`` turns every hook into an early return.
+    pipeline: PipelineObservatoryConfig = field(
+        default_factory=PipelineObservatoryConfig)
 
 
 @dataclass

@@ -236,6 +236,15 @@ class DhtRunner:
                 dht.reshard.set_history(self._history)
             except AttributeError:
                 pass
+            # pipeline observatory (round 22): the recorder's frame
+            # cadence IS the windowed-reset cadence — each committed
+            # frame rolls the wave builder's windowed in-flight peak
+            # and pushes an occupancy window checkpoint
+            try:
+                self._history.add_frame_hook(
+                    lambda _frame, _wb=dht.wave_builder: _wb.frame_tick())
+            except AttributeError:
+                pass
 
         # health observatory (round 14): the declarative SLO engine +
         # node verdict, evaluated on a periodic scheduler tick riding
@@ -942,6 +951,7 @@ class DhtRunner:
             cache=self.get_cache(),
             ingest=ingest,
             waterfall=self.get_profile(),
+            pipeline=self.get_pipeline(),
         )
 
     def get_bundles(self) -> list:
@@ -1026,6 +1036,34 @@ class DhtRunner:
             return doc
         except Exception:
             return {"enabled": False}
+
+    def get_pipeline(self) -> dict:
+        """The pipeline utilization snapshot (ISSUE-18): the windowed
+        device-occupancy gauge, per-cause bubble attribution, measured
+        fill∥device overlap ratio and the pipeline shape (depth /
+        in-flight / windowed peak) — the JSON the proxy's ``GET
+        /pipeline`` route serves, the ``pipeline`` REPL command
+        prints, and the scanner's ``pipeline`` section embeds."""
+        try:
+            wb = getattr(self._dht, "wave_builder", None)
+            if wb is None:
+                return {"enabled": False}
+            return wb.pipeline_snapshot()
+        except Exception:
+            return {"enabled": False}
+
+    def get_pipeline_trace(self) -> dict:
+        """Perfetto lane export of the retained wave timeline (``GET
+        /pipeline?fmt=trace``): one pid per lane (fill / device /
+        drain), waves as slices linked to their ``dht.search.wave``
+        spans.  Empty trace when the observatory is off."""
+        try:
+            obs = getattr(self._dht.wave_builder, "observatory", None)
+            if obs is None or not obs.enabled:
+                return {"traceEvents": [], "displayTimeUnit": "ms"}
+            return obs.chrome_trace()
+        except Exception:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
 
     def get_trace(self, trace_id) -> list:
         """JSON-able span list of one distributed trace (ISSUE-4): the

@@ -85,6 +85,7 @@ from typing import Callable, List
 
 from .. import telemetry, tracing, waterfall
 from ..infohash import InfoHash
+from ..pipeline_observatory import PipelineObservatory, PipelineObservatoryConfig
 from ..rate_limiter import RateLimiter
 
 log = logging.getLogger("opendht_tpu.ingest")
@@ -136,11 +137,11 @@ class _InflightWave:
     device stage can be observed at consume."""
 
     __slots__ = ("af", "k", "entries", "handle", "t_dispatch",
-                 "dispatch_s", "t_pick", "probe_s", "slot")
+                 "dispatch_s", "t_pick", "probe_s", "slot", "seq")
 
     def __init__(self, af: int, k: int, entries: List[_Entry], handle,
                  t_dispatch: float, dispatch_s: float, t_pick: float,
-                 probe_s: float, slot: int):
+                 probe_s: float, slot: int, seq: int = -1):
         self.af = af
         self.k = k
         self.entries = entries
@@ -150,6 +151,7 @@ class _InflightWave:
         self.t_pick = t_pick          # wall clock at wave pickup
         self.probe_s = probe_s        # cache-probe share of this wave
         self.slot = slot              # waves already in flight at launch
+        self.seq = seq                # pipeline-observatory wave id
 
 
 class WaveBuilder:
@@ -178,7 +180,17 @@ class WaveBuilder:
         self._drain_job = None        # armed drainer Job or None
         self._exempt = 0              # admission suspended (see exempt())
         self.waves = 0                # launches issued (cheap introspection)
-        self.inflight_peak = 0        # max concurrent in-flight waves seen
+        # windowed in-flight peak (round 22): high-water since the last
+        # history frame; _peak_prev retains the previous frame so the
+        # gauge never blinks to 0 mid-window (frame_tick rolls both)
+        self.inflight_peak = 0
+        self._peak_prev = 0
+        # round 22: the pipeline utilization observatory — lane
+        # timelines, device occupancy, bubble attribution.  Host-side
+        # edge bookkeeping only; kernels stay bit-identical.
+        pcfg = getattr(config, "pipeline", None)
+        self.observatory = PipelineObservatory(
+            pcfg if pcfg is not None else PipelineObservatoryConfig())
 
         reg = telemetry.get_registry()
         self._m_depth = reg.gauge("dht_ingest_queue_depth")
@@ -270,7 +282,12 @@ class WaveBuilder:
             cb(self._dht.find_closest_nodes_batched([target], af, k)[0])
             return
         now = self._dht.scheduler.time()
-        self._pending.append(_Entry(target, af, k, cb, now, _time.time(),
+        t_wall = _time.time()
+        if not self._pending:
+            # queue went 0 -> 1: the next wave starts batching here —
+            # the fill_start edge of its lane timeline
+            self.observatory.note_fill_start(t_wall)
+        self._pending.append(_Entry(target, af, k, cb, now, t_wall,
                                     tracing.current(), kind, cache_cb))
         depth = len(self._pending)
         self._m_depth.set(depth)
@@ -327,6 +344,8 @@ class WaveBuilder:
             # backpressure: never more than depth waves in flight — the
             # oldest wave's scatter is paid here, while its successors
             # keep the device busy
+            if len(self._inflight) >= self.pipeline_depth:
+                self.observatory.note_backpressure()
             while len(self._inflight) >= self.pipeline_depth:
                 self._drain_one(wf)
         # waterfall (round 19): queue_wait = admission → wave pickup,
@@ -334,12 +353,16 @@ class WaveBuilder:
         # here, before the cache probe, so a cache-served op still
         # contributes its coalesce tax
         t_pick = _time.time()
+        # fill_done edge: the observatory hands back this wave group's
+        # fill_start and re-arms for the next (None with the plane off)
+        t_fill = self.observatory.take_fill(t_pick)
         if wf.enabled:
             for e in batch:
                 wf.observe("queue_wait", max(0.0, t_pick - e.t_wall),
                            exemplar=e.ctx.trace_hex if e.ctx else None)
         cache = getattr(self._dht, "hotcache", None)
         probe_s = 0.0
+        n_submitted = len(batch)
         if cache is not None and cache.active():
             # time the probe ONLY when a cache is actually live — a
             # cache-off wave would flood the cache_probe histogram
@@ -351,16 +374,21 @@ class WaveBuilder:
                 wf.observe("cache_probe", probe_s)
         else:
             batch = self._serve_cached(batch)
+        if not batch and n_submitted:
+            # the whole wave was served from cache — the device was
+            # (correctly) skipped; the idle gap this opens is a
+            # cache_served bubble, not starvation
+            self.observatory.note_cache_served(t_fill, n_submitted)
         if batch:
             groups: dict = {}
             for e in batch:
                 groups.setdefault((e.af, e.k), []).append(e)
             if self.pipeline_depth <= 1:
                 for (af, k), entries in groups.items():
-                    self._launch(af, k, entries, wf, t_pick, probe_s)
+                    self._launch(af, k, entries, wf, t_pick, probe_s, t_fill)
                 return
             for (af, k), entries in groups.items():
-                self._launch_async(af, k, entries, wf, t_pick, probe_s)
+                self._launch_async(af, k, entries, wf, t_pick, probe_s, t_fill)
             # opportunistic same-pump drain: a wave whose handle is
             # already materialized (host-scan resolve — the live
             # protocol regime) scatters now, keeping small-table
@@ -407,13 +435,18 @@ class WaveBuilder:
 
     def _launch(self, af: int, k: int, entries: List[_Entry],
                 wf=None, t_pick: "float | None" = None,
-                probe_s: float = 0.0) -> None:
+                probe_s: float = 0.0,
+                t_fill: "float | None" = None) -> None:
         """Depth-1 wave: the exact pre-pipeline launch→block→scatter
         path (``ingest_pipeline_depth=1``, the escape hatch)."""
         reg = telemetry.get_registry()
         if wf is None:
             wf = waterfall.get_profiler()
         t_fire = _time.time()
+        # depth-1 lifecycle: device busy exactly for the blocking
+        # launch; dispatch and wait are one edge pair here
+        seq = self.observatory.on_dispatch(
+            t_fill, t_fire, len(entries), af, k, 0, self._reshard_gen())
         with reg.span("dht_ingest_wave_seconds") as sp:
             try:
                 results = self._dht.find_closest_nodes_batched(
@@ -423,17 +456,23 @@ class WaveBuilder:
                               af, k, len(entries))
                 results = None
         t_avail = _time.time()
+        self.observatory.on_device_done(seq, t_avail)
         if results is None:
             entries = self._requeue_failed(entries)
             if not entries:
+                # every entry requeued onto a later wave: close THIS
+                # wave's lane slices now — no orphan open intervals
+                self.observatory.on_scatter_done(seq, _time.time())
                 return
             results = [[] for _ in entries]
         shard_t = int(getattr(self._dht, "last_resolve_shard_t", 1) or 1)
         self._scatter(af, k, entries, results, wf, t_pick, probe_s,
-                      t_fire, sp.elapsed, shard_t, t_avail, slot=0)
+                      t_fire, sp.elapsed, shard_t, t_avail, slot=0,
+                      obs_seq=seq)
 
     def _launch_async(self, af: int, k: int, entries: List[_Entry],
-                      wf, t_pick: float, probe_s: float) -> None:
+                      wf, t_pick: float, probe_s: float,
+                      t_fill: "float | None" = None) -> None:
         """Depth-2+ wave: dispatch the ``[Q]`` launch and return with
         the kernel in flight — the scatter belongs to the drainer."""
         t_dispatch = _time.time()
@@ -445,20 +484,34 @@ class WaveBuilder:
                           af, k, len(entries))
             entries = self._requeue_failed(entries)
             if entries:
-                # retries spent: scatter empty honestly, depth-1 style
+                # retries spent: scatter empty honestly, depth-1 style.
+                # The dispatch never reached the device, so no device
+                # interval is opened (obs_seq=-1: nothing to close).
                 self._scatter(af, k, entries, [[] for _ in entries], wf,
                               t_pick, probe_s, t_dispatch, 0.0, 1,
                               _time.time(), slot=len(self._inflight))
             return
+        seq = self.observatory.on_dispatch(
+            t_fill, t_dispatch, len(entries), af, k,
+            len(self._inflight), self._reshard_gen())
         dispatch_s = max(0.0, _time.time() - t_dispatch)
+        if wf.enabled:
+            # satellite fix (round 22): host-side dispatch cost is its
+            # own stage, observed AT LAUNCH — the in-flight window no
+            # longer folds into queue_wait or the device stage.  The
+            # first (af, k) dispatch carries tracing/lowering cost; the
+            # consume-side device_compile split still owns that story.
+            wf.observe("dispatch", dispatch_s,
+                       exemplar=next((e.ctx.trace_hex for e in entries
+                                      if e.ctx is not None), None))
         self._inflight.append(_InflightWave(
             af, k, entries, handle, t_dispatch, dispatch_s, t_pick,
-            probe_s, slot=len(self._inflight)))
+            probe_s, slot=len(self._inflight), seq=seq))
         n = len(self._inflight)
         self._m_inflight.set(n)
         if n > self.inflight_peak:
             self.inflight_peak = n
-            self._m_inflight_peak.set(n)
+            self._m_inflight_peak.set(max(n, self._peak_prev))
 
     # ------------------------------------------------------------- drain
     def _arm_drain(self, t: float) -> None:
@@ -497,21 +550,29 @@ class WaveBuilder:
                           w.af, w.k, len(w.entries))
             results = None
         t_avail = _time.time()
-        # the waterfall device stage at consume: dispatch cost + the
-        # blocking wait actually paid here.  Host time the wave spent
-        # in flight between pumps is overlap, not device cost — it is
-        # visible as the wave span's wall duration instead.
-        dev_s = w.dispatch_s + max(0.0, t_avail - t_wait0)
-        self._m_wave_s.observe(dev_s)
+        self.observatory.on_device_done(w.seq, t_avail)
+        # the waterfall device stage at consume: the blocking wait
+        # actually paid here (device_wait; the host dispatch cost was
+        # observed as its own stage at launch — round-22 satellite).
+        # Host time the wave spent in flight between pumps is overlap,
+        # not device cost — it is visible as the wave span's wall
+        # duration instead.  The wave_seconds histogram keeps its
+        # round-20 dispatch+wait semantics.
+        wait_s = max(0.0, t_avail - t_wait0)
+        self._m_wave_s.observe(w.dispatch_s + wait_s)
         entries = w.entries
         if results is None:
             entries = self._requeue_failed(entries)
             if not entries:
+                # fully requeued: close this wave's lane slices so the
+                # timeline never leaks an orphan open interval
+                self.observatory.on_scatter_done(w.seq, _time.time())
                 return
             results = [[] for _ in entries]
         self._scatter(w.af, w.k, entries, results, wf, w.t_pick,
-                      w.probe_s, w.t_dispatch, dev_s,
-                      w.handle.shard_t, t_avail, slot=w.slot)
+                      w.probe_s, w.t_dispatch, wait_s,
+                      w.handle.shard_t, t_avail, slot=w.slot,
+                      dispatch_s=w.dispatch_s, obs_seq=w.seq)
 
     def _requeue_failed(self, entries: List[_Entry]) -> List[_Entry]:
         """A failed launch must not fail its carried (already admitted)
@@ -522,6 +583,9 @@ class WaveBuilder:
         infrastructure failure, not backpressure)."""
         telemetry.get_registry().counter(
             "dht_ingest_wave_failures_total").inc()
+        # the retry round-trip owns the device-idle gap it opens: the
+        # NEXT dispatch's bubble is attributed launch_retry
+        self.observatory.note_launch_retry()
         requeue = [e for e in entries if e.retries < _LAUNCH_RETRIES]
         exhausted = [e for e in entries if e.retries >= _LAUNCH_RETRIES]
         if requeue:
@@ -537,10 +601,20 @@ class WaveBuilder:
             self._arm(self._dht.scheduler.time() + self.deadline)
         return exhausted
 
+    def _reshard_gen(self) -> int:
+        """Boundary generation currently serving (0 = uniform split) —
+        the observatory tags each wave with it so a hot swap between
+        waves classifies the idle gap as ``reshard_swap``."""
+        rs = getattr(self._dht, "reshard", None)
+        if rs is not None and getattr(rs, "layout", None) is not None:
+            return int(rs.layout.gen)
+        return 0
+
     def _scatter(self, af: int, k: int, entries: List[_Entry], results,
                  wf, t_pick: "float | None", probe_s: float,
                  t_dispatch: float, dev_elapsed: float, shard_t: int,
-                 t_avail: float, slot: int) -> None:
+                 t_avail: float, slot: int, dispatch_s: float = 0.0,
+                 obs_seq: int = -1) -> None:
         """Fan a wave's results out to the carried ops' callbacks, with
         all the per-wave bookkeeping (metrics, keyspace, waterfall
         stages, trace spans) — shared verbatim by the synchronous
@@ -570,12 +644,14 @@ class WaveBuilder:
         # group carries XLA compilation — split so a one-time lowering
         # never poisons the serving p99 (host-side bookkeeping only;
         # the launch itself is untouched).  With the pipeline this is
-        # observed at CONSUME (dispatch + blocking wait), where the
-        # device cost is actually known.
-        dev_stage = "device_launch"
+        # observed at CONSUME (the blocking wait; the host dispatch
+        # share was observed as the "dispatch" stage at launch —
+        # round-22 satellite; "device_launch" remains as a one-release
+        # alias of device_wait, see waterfall.STAGE_ALIASES).
+        dev_stage = "device_wait"
         if wf.enabled:
             dev_stage = ("device_compile" if wf.first_launch((af, k))
-                         else "device_launch")
+                         else "device_wait")
             wf.observe(dev_stage, dev_elapsed,
                        exemplar=next((e.ctx.trace_hex for e in entries
                                       if e.ctx is not None), None))
@@ -632,20 +708,56 @@ class WaveBuilder:
                 # overlaps the device stages and is deliberately absent
                 t_done = _time.time()
                 base = t_pick if t_pick is not None else t_dispatch
-                wf.record_op(e.kind, {
+                stages = {
                     "queue_wait": max(0.0, base - e.t_wall),
                     "cache_probe": probe_s,
                     dev_stage: dev_elapsed,
                     "scatter_back": max(0.0, t_done - t_avail),
-                }, end_to_end=max(0.0, t_done - e.t_wall),
-                    trace_id=e.ctx.trace_hex if e.ctx else None)
+                }
+                if dispatch_s > 0.0:
+                    stages["dispatch"] = dispatch_s
+                wf.record_op(e.kind, stages,
+                             end_to_end=max(0.0, t_done - e.t_wall),
+                             trace_id=e.ctx.trace_hex if e.ctx else None)
         if wf.enabled:
             # ONE scatter_back observation per wave (the whole fan-out
             # loop) — the per-op slices live in the records above
             wf.observe("scatter_back",
                        max(0.0, _time.time() - t_avail))
+        # scatter_done edge: closes the wave's lane slices, linking the
+        # timeline record to its dht.search.wave span for Perfetto
+        self.observatory.on_scatter_done(
+            obs_seq, _time.time(),
+            trace=wave_ctx.trace_hex if wave_ctx is not None else "",
+            span=wave_ctx.span_hex if wave_ctx is not None else "")
 
     # ---------------------------------------------------------- inspection
+    def frame_tick(self) -> None:
+        """History-ring frame hook (round 22): roll the windowed
+        in-flight peak (satellite fix — ``dhtmon --window`` should see
+        the CURRENT pipeline depth, not a boot-time spike) and push an
+        occupancy window checkpoint into the observatory.  The exported
+        gauge is max(previous frame, current frame) so it never blinks
+        to zero at the frame edge while waves are still in flight."""
+        self._peak_prev = self.inflight_peak
+        self.inflight_peak = len(self._inflight)
+        self._m_inflight_peak.set(
+            float(max(self._peak_prev, self.inflight_peak)))
+        self.observatory.on_frame()
+
+    def pipeline_snapshot(self) -> dict:
+        """Utilization snapshot for ``GET /pipeline`` / the ``pipeline``
+        REPL cmd / ``dhtscanner --json``: the observatory's occupancy /
+        bubble / overlap ledger plus the builder's pipeline shape."""
+        doc = self.observatory.snapshot()
+        doc.update({
+            "pipeline_depth": self.pipeline_depth,
+            "inflight": len(self._inflight),
+            "inflight_peak": max(self.inflight_peak, self._peak_prev),
+            "queue_depth": len(self._pending),
+        })
+        return doc
+
     def snapshot(self) -> dict:
         """JSON-able ingest state for the ops tools (``dhtscanner
         --json`` "ingest" section, the dhtnode REPL ``ingest`` cmd)."""
@@ -660,7 +772,7 @@ class WaveBuilder:
             "batching": "on" if self.enabled else "off",
             "pipeline_depth": self.pipeline_depth,
             "inflight": len(self._inflight),
-            "inflight_peak": self.inflight_peak,
+            "inflight_peak": max(self.inflight_peak, self._peak_prev),
             "table_shard_t": shard_t,
             "sharded_waves": int(self._m_sharded_waves.value),
             "fill_target": self.fill_target,

@@ -39,7 +39,7 @@ from typing import List, Optional
 from ..health import (parse_alerts, percentile_breaches,
                       quantile_from_cumulative)
 from ..testing import health_monitor as hm
-from ..waterfall import STAGES
+from ..waterfall import STAGE_ALIASES, STAGES
 
 #: ``dht_stage_seconds_bucket{stage="queue_wait",le="0.001"}`` →
 #: (stage, le) — both label orders, like health_monitor._BUCKET_RE
@@ -74,7 +74,8 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                window: float = 0.0, since: Optional[float] = None,
                max_imbalance: Optional[float] = None,
                min_cache_hit: Optional[float] = None,
-               max_stage: Optional[dict] = None) -> tuple:
+               max_stage: Optional[dict] = None,
+               min_occupancy: Optional[float] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
     is the JSON-able cluster report and ``violations`` is a list of
     human-readable invariant failures (empty = healthy).
@@ -119,7 +120,14 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     ``dht_stage_seconds`` buckets must not exceed the stage's
     threshold.  Per-node like the other gauge gates (one slow node
     must not hide inside a cluster merge); a never-observed stage is
-    unknown and never violates."""
+    unknown and never violates.
+
+    ``min_occupancy`` gates the round-22 pipeline observatory: the
+    worst node's ``dht_pipeline_occupancy`` gauge (windowed fraction
+    of wall clock with >= 1 wave in flight on the device) must not
+    drop below it — the SAME unknown contract as the other gauge
+    gates: a -1/absent gauge (observatory off, or no window closed
+    yet) never violates."""
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
@@ -245,6 +253,30 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                        key=lambda p: p["hit_ratio"]
                        if p["hit_ratio"] is not None else 2.0)
                    ["endpoint"]))
+    if min_occupancy is not None and scrapes:
+        # per-node, worst = MIN: the gate is "every node's device is
+        # actually being kept busy by its pipeline" — -1/absent =
+        # unknown (observatory off / no closed window), never a
+        # violation, matching the other gauge gates
+        per_node = []
+        for s in scrapes:
+            vals = [v for name, v in s["series"].items()
+                    if name.startswith("dht_pipeline_occupancy")
+                    and v >= 0]
+            per_node.append({"endpoint": s["endpoint"],
+                             "occupancy": min(vals) if vals else None})
+        known = [p["occupancy"] for p in per_node
+                 if p["occupancy"] is not None]
+        worst = min(known) if known else None
+        doc["pipeline_occupancy"] = {"min": worst, "per_node": per_node}
+        if worst is not None and worst < min_occupancy:
+            violations.append(
+                "pipeline occupancy %.4f below %.4f (worst node %s)"
+                % (worst, min_occupancy,
+                   min(per_node,
+                       key=lambda p: p["occupancy"]
+                       if p["occupancy"] is not None else 2.0)
+                   ["endpoint"]))
     if max_stage and scrapes:
         # per-node, worst = MAX p95 per stage: the gate is "no node's
         # serving stage blew its latency budget" — a stage with no
@@ -336,15 +368,27 @@ def main(argv=None) -> int:
                         "below R — unknown (-1/absent: cache disabled "
                         "or no probe window) never violates, matching "
                         "the --max-imbalance contract")
+    p.add_argument("--min-occupancy", type=float, default=None,
+                   metavar="R",
+                   help="fail when any node's pipeline device "
+                        "occupancy (dht_pipeline_occupancy: windowed "
+                        "fraction of wall clock with >=1 wave in "
+                        "flight, from the round-22 observatory) drops "
+                        "below R — unknown (-1/absent: observatory "
+                        "off or no closed window) never violates, "
+                        "matching the --min-cache-hit contract")
     p.add_argument("--max-stage", action="append", default=[],
                    metavar="STAGE=SEC",
                    help="fail when any node's p95 for a round-19 "
                         "waterfall stage (dht_stage_seconds: "
                         "queue_wait, cache_probe, device_compile, "
-                        "device_launch, scatter_back, rpc_wait) "
-                        "exceeds SEC (repeatable, e.g. --max-stage "
-                        "device_launch=0.25); a never-observed stage "
-                        "is unknown and never violates")
+                        "dispatch, device_wait, scatter_back, "
+                        "rpc_wait) exceeds SEC (repeatable, e.g. "
+                        "--max-stage device_wait=0.25); "
+                        "device_launch is accepted as a one-release "
+                        "alias of device_wait (round-22 stage split); "
+                        "a never-observed stage is unknown and never "
+                        "violates")
     p.add_argument("--json", action="store_true",
                    help="emit the full cluster report as one JSON doc")
     args = p.parse_args(argv)
@@ -356,6 +400,10 @@ def main(argv=None) -> int:
     max_stage: dict = {}
     for spec in args.max_stage:
         stage, eq, sec = spec.partition("=")
+        # one-release compatibility (round 22): --max-stage
+        # device_launch=... resolves to the canonical device_wait
+        # stage instead of silently failing to match anything
+        stage = STAGE_ALIASES.get(stage, stage)
         try:
             if not eq or stage not in STAGES:
                 raise ValueError
@@ -376,7 +424,8 @@ def main(argv=None) -> int:
             window=args.window, since=args.since,
             max_imbalance=args.max_imbalance,
             min_cache_hit=args.min_cache_hit,
-            max_stage=max_stage or None)
+            max_stage=max_stage or None,
+            min_occupancy=args.min_occupancy)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
         return 2
@@ -406,6 +455,11 @@ def main(argv=None) -> int:
         if ch:
             print("cache hit ratio: %s (worst node)" % (
                 "%.3f" % ch["min"] if ch["min"] is not None
+                else "unknown"))
+        po = doc.get("pipeline_occupancy")
+        if po:
+            print("pipeline occupancy: %s (worst node)" % (
+                "%.4f" % po["min"] if po["min"] is not None
                 else "unknown"))
         for stage, w in sorted((doc.get("stages") or {})
                                .get("worst", {}).items()):

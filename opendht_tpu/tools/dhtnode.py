@@ -65,6 +65,15 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            the full snapshot (incl. per-op records +
                            bucket exemplars), 'folded' prints
                            flamegraph-shaped folded stacks
+    pipeline [json]        pipeline utilization observatory (round
+                           22): windowed device occupancy, per-cause
+                           device-idle bubble attribution (queue_empty
+                           / fill_slow / drain_backpressure /
+                           launch_retry / reshard_swap / cache_served),
+                           measured fill∥device overlap ratio and the
+                           pipeline shape — the same data the proxy
+                           serves on GET /pipeline (?fmt=trace there
+                           for the Perfetto lane export)
     cache [json]           hot-key serving cache (round 16): occupancy,
                            per-entry hit counts, windowed hit ratio,
                            invalidation/eviction totals and the
@@ -380,7 +389,7 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                         "stage", "count", "p50 ms", "p95 ms", "p99 ms",
                         "budget ms"))
                     for stage, d in snap["stages"].items():
-                        if not d.get("count"):
+                        if not d.get("count") or d.get("alias_of"):
                             continue
                         print("%-16s %8d %10.3f %10.3f %10.3f %10.1f" % (
                             stage, d["count"], d["p50"] * 1e3,
@@ -397,6 +406,39 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                                 key_, "%.3f" % b["value"]
                                 if b["value"] is not None
                                 else "no measurement"))
+            elif op == "pipeline":
+                # pipeline utilization observatory (round 22,
+                # ISSUE-18): same snapshot the proxy serves on
+                # GET /pipeline
+                import json as _json
+                snap = node.get_pipeline()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("pipeline observatory disabled")
+                else:
+                    occ = snap.get("occupancy", -1.0)
+                    print("occupancy %s (window %.0fs)  depth %d  "
+                          "inflight %d (peak %d)  overlap %s" % (
+                              "%.1f%%" % (occ * 100) if occ >= 0
+                              else "unknown",
+                              snap.get("window_s", 0.0),
+                              snap.get("pipeline_depth", 1),
+                              snap.get("inflight", 0),
+                              snap.get("inflight_peak", 0),
+                              "%.2fx" % snap["overlap_ratio"]
+                              if snap.get("overlap_ratio", -1) >= 0
+                              else "unknown"))
+                    print("%d wave(s), device busy %.3fs total" % (
+                        snap.get("waves_total", 0),
+                        snap.get("busy_seconds_total", 0.0)))
+                    bubbles = snap.get("bubbles", {})
+                    for cause, d in bubbles.items():
+                        if d.get("count"):
+                            print("  bubble %-18s %6d gap(s) %8.3fs" % (
+                                cause, d["count"], d["seconds"]))
+                    top = snap.get("top_bubble_cause")
+                    print("top bubble cause: %s" % (top or "none"))
             elif op == "bundle":
                 # post-mortem black-box bundle (round 17): same
                 # artifact the proxy serves on GET /debug/bundle

@@ -67,6 +67,7 @@ def topology_snapshot(node) -> dict:
         "cache": {},
         "reshard": {},
         "waterfall": {},
+        "pipeline": {},
         "chaos": {},
         "events": [],
     }
@@ -76,6 +77,14 @@ def topology_snapshot(node) -> dict:
         # op's milliseconds went between snapshots, not just the
         # end-to-end total
         snap["waterfall"] = node.get_profile()
+    except Exception:
+        pass
+    try:
+        # round-22 pipeline observatory: windowed device occupancy,
+        # per-cause bubble attribution and overlap ratio, so a soak
+        # diff shows WHETHER the device stayed busy between snapshots
+        # and whose fault the gaps were
+        snap["pipeline"] = node.get_pipeline()
     except Exception:
         pass
     try:

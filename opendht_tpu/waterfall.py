@@ -15,18 +15,22 @@ slow, the always-on stage profiler says *why*.
 - ``cache_probe`` — the round-16 hot-cache XOR-compare launch + serve
   window at the head of every wave.
 - ``device_compile`` — the FIRST timed launch per (family, k) group
-  shape: XLA compilation rides that call, and folding it into
-  ``device_launch`` would poison the p99 forever.  Split host-side by
-  first-launch tracking — the kernels themselves are untouched.
-- ``device_launch`` — the wave's device cost, measured AT CONSUME
-  since the round-20 pipeline: async dispatch cost + the blocking wait
-  actually paid when results are used (``BatchedResolve.consume``).
-  For ``ingest_pipeline_depth=1`` that collapses to the old timed
+  shape: XLA compilation rides that call, and folding it into the
+  serving device stage would poison the p99 forever.  Split host-side
+  by first-launch tracking — the kernels themselves are untouched.
+- ``dispatch`` — the host-side async-dispatch cost of a wave, measured
+  AT LAUNCH (round 22; the ``find_closest_nodes_launch`` call itself).
+- ``device_wait`` — the blocking wait actually paid when results are
+  used (``BatchedResolve.consume``), measured AT CONSUME.  For
+  ``ingest_pipeline_depth=1`` this collapses to the old timed
   launch→block span of ``find_closest_nodes_batched``; at depth 2+ the
   wave's host-overlap window (launch → drain pump) is deliberately NOT
   device cost — it shows as the ``dht.search.wave`` span's wall
-  duration, and the in-flight count rides the
-  ``dht_ingest_pipeline_inflight`` gauge (+ ``_peak``).
+  duration, the ``dht_ingest_pipeline_inflight`` gauge (+ windowed
+  ``_peak``) and, since round 22, the pipeline observatory's device
+  lane (``pipeline_observatory.py``).  ``device_launch`` is a
+  one-release alias of ``device_wait`` (:data:`STAGE_ALIASES`) so
+  existing ``dhtmon --max-stage`` invocations keep matching.
 - ``scatter_back`` — results materialized → each op's scatter callback
   returned (result fan-out + trace recording).
 - ``rpc_wait`` — network hop RTTs off the round-4 per-hop spans
@@ -85,14 +89,27 @@ from typing import Dict, List, Optional
 from . import telemetry
 
 __all__ = [
-    "STAGES", "DEFAULT_STAGE_BUDGETS", "WaterfallConfig", "StageProfiler",
-    "OpenBoundTracker", "get_profiler",
+    "STAGES", "STAGE_ALIASES", "DEFAULT_STAGE_BUDGETS", "WaterfallConfig",
+    "StageProfiler", "OpenBoundTracker", "get_profiler",
 ]
 
 #: the waterfall stages, in serving-path order (rpc_wait overlaps the
-#: device stages — it is a parallel plane, not a pipeline step)
-STAGES = ("queue_wait", "cache_probe", "device_compile", "device_launch",
-          "scatter_back", "rpc_wait")
+#: device stages — it is a parallel plane, not a pipeline step).
+#: Round 22 split the old overlapped ``device_launch`` into
+#: ``dispatch`` (host-side async-dispatch cost, measured AT LAUNCH)
+#: and ``device_wait`` (the blocking wait actually paid at consume) —
+#: at depth >= 2 the two happen pumps apart, and folding them into one
+#: stage made in-flight device time reappear as queue_wait.
+STAGES = ("queue_wait", "cache_probe", "device_compile", "dispatch",
+          "device_wait", "scatter_back", "rpc_wait")
+
+#: one-release compatibility aliases (round 22): old stage name →
+#: canonical stage.  ``observe("device_launch", ...)`` and ``dhtmon
+#: --max-stage device_launch=...`` keep working against the
+#: ``device_wait`` histogram; snapshots mirror the entry under both
+#: keys with an ``alias_of`` marker.  Scheduled for removal next
+#: release — switch invocations to ``device_wait``.
+STAGE_ALIASES = {"device_launch": "device_wait"}
 
 #: per-stage latency budgets (seconds) the ``stage_budget`` health
 #: signal and ``dhtmon --max-stage`` default to: generous CPU-safe
@@ -102,7 +119,8 @@ DEFAULT_STAGE_BUDGETS = {
     "queue_wait": 0.020,      # 10x the default ingest deadline knob
     "cache_probe": 0.050,
     "device_compile": 120.0,  # one-time XLA lowering, not a serving SLI
-    "device_launch": 0.250,
+    "dispatch": 0.050,        # host async-dispatch share of a wave
+    "device_wait": 0.250,
     "scatter_back": 0.050,
     "rpc_wait": 3.5,          # 3 attempts x 1 s + slack (request.py)
 }
@@ -143,14 +161,25 @@ class StageProfiler:
         self.enabled = self.cfg.enabled
         self._h = {s: self._reg.histogram("dht_stage_seconds", stage=s)
                    for s in STAGES}
+        # aliases map to the SAME Histogram object: an old-name observe
+        # or a direct _h["device_launch"] access lands in the canonical
+        # series — nothing double-counts, nothing goes dark
+        for old, new in STAGE_ALIASES.items():
+            self._h[old] = self._h[new]
         self._ops: deque = deque(maxlen=max(1, self.cfg.op_ring))
         self._compiled: set = set()       # (af, k) groups already launched
         self.budgets = dict(DEFAULT_STAGE_BUDGETS)
-        self.budgets.update(self.cfg.budgets or {})
+        self.budgets.update(self._resolve_budget_aliases(self.cfg.budgets))
         # budget-window baselines: stage -> (count, sum, {bucket: n})
         self._win_prev: Dict[str, tuple] = {}
         self._lock = threading.Lock()
         self._publish_budgets()
+
+    @staticmethod
+    def _resolve_budget_aliases(budgets: Optional[dict]) -> dict:
+        """Config budget overrides keyed by an aliased stage name apply
+        to the canonical stage (one-release compatibility)."""
+        return {STAGE_ALIASES.get(k, k): v for k, v in (budgets or {}).items()}
 
     def _publish_budgets(self) -> None:
         """Stage budgets as ``dht_stage_budget_seconds{stage=}`` gauges
@@ -168,7 +197,7 @@ class StageProfiler:
         self.cfg = cfg
         self.enabled = cfg.enabled
         self.budgets = dict(DEFAULT_STAGE_BUDGETS)
-        self.budgets.update(cfg.budgets or {})
+        self.budgets.update(self._resolve_budget_aliases(cfg.budgets))
         if self._ops.maxlen != max(1, cfg.op_ring):
             self._ops = deque(self._ops, maxlen=max(1, cfg.op_ring))
         self._publish_budgets()
@@ -257,6 +286,10 @@ class StageProfiler:
             d["p95"] = h.quantile(0.95)
             d["p99"] = h.quantile(0.99)
             stages[s] = d
+        # one-release alias mirror: readers keyed on the old name see
+        # the canonical stage's data, marked so they can migrate
+        for old, new in STAGE_ALIASES.items():
+            stages[old] = dict(stages[new], alias_of=new)
         return {
             "enabled": self.enabled,
             "stages": stages,
@@ -383,6 +416,15 @@ class OpenBoundTracker:
             # i.e. static p50 latency / churny p50 latency
             return static / churn
         if key == "ingest_wave_occupancy":
+            # round 22: prefer the pipeline observatory's MEASURED
+            # device-occupancy gauge (fraction of wall clock with >= 1
+            # wave in flight, windowed on the history cadence) — the
+            # bound tracks live utilization now, not a settling command
+            # alone.  -1 is the gauge's "unknown" sentinel; fall back
+            # to the wave-width histogram mean until it goes live.
+            for _k, g in reg.series("dht_pipeline_occupancy").items():
+                if g.value >= 0.0:
+                    return float(g.value)
             occ = None
             for _k, h in reg.series("dht_ingest_wave_occupancy").items():
                 c, s, _b = h.raw()

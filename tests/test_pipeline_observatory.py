@@ -1,0 +1,534 @@
+"""Pipeline utilization observatory (round 22, pipeline_observatory.py).
+
+Pins the observatory's contract: per-wave lifecycle edges fold into a
+closed busy/bubble ledger (Σ busy + Σ attributed bubbles == observed
+window, checked against a host-side scalar oracle), every device-idle
+gap is attributed to exactly one cause, the occupancy gauge windows on
+the history-frame cadence, lane records export one Perfetto pid per
+lane, and — the failure-path guarantee — launch-retry requeues,
+mid-drain device errors and reshard swaps between waves all close
+their lane slices (no orphan open intervals) with the right bubble
+cause, at every pipeline depth.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+
+import pytest
+
+from opendht_tpu import telemetry
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.pipeline_observatory import (
+    BUBBLE_CAUSES,
+    STARVED_CAUSES,
+    PipelineObservatory,
+    PipelineObservatoryConfig,
+)
+from opendht_tpu.runtime.live_search import SEARCH_NODES
+
+from test_wave_builder import _pump, fake_launch, make_dht
+
+AF = _socket.AF_INET
+
+
+def make_obs(**cfg_kw):
+    """Observatory on a fake clock and a private registry."""
+    clock = {"t": 100.0}
+    obs = PipelineObservatory(PipelineObservatoryConfig(**cfg_kw),
+                              registry=telemetry.MetricsRegistry(),
+                              clock=lambda: clock["t"])
+    return obs, clock
+
+
+def run_wave(obs, clock, *, fill_wait=0.0, device=0.05, drain=0.002,
+             n=8, gen=0, slot=0):
+    """One full lifecycle through the edge API; returns the seq."""
+    obs.note_fill_start(clock["t"])
+    clock["t"] += fill_wait
+    t_fill = obs.take_fill(clock["t"])
+    seq = obs.on_dispatch(t_fill, clock["t"], n, AF, 8, slot, gen)
+    clock["t"] += device
+    obs.on_device_done(seq, clock["t"])
+    clock["t"] += drain
+    obs.on_scatter_done(seq, clock["t"])
+    return seq
+
+
+# ==================================================== unit: the ledger
+def test_account_closed_against_scalar_oracle():
+    """The acceptance oracle: replay a scripted edge sequence and track
+    busy/idle intervals with independent scalar arithmetic — the
+    observatory's ledger must attribute every second of the observed
+    window (Σ busy + Σ bubbles == span, no double count, no leak)."""
+    obs, clock = make_obs()
+    oracle_busy = 0.0
+    oracle_gaps = 0.0
+    t_start = clock["t"]
+    last_idle = clock["t"]
+
+    # waves with varying fill/device/drain geometry and idle gaps
+    script = [(0.010, 0.050, 0.002, 0.000),
+              (0.001, 0.020, 0.001, 0.030),
+              (0.040, 0.005, 0.004, 0.015),
+              (0.002, 0.100, 0.000, 0.000)]
+    for fill_wait, device, drain, idle in script:
+        clock["t"] += idle            # device sits idle before the fill
+        obs.note_fill_start(clock["t"])
+        clock["t"] += fill_wait
+        t_fill = obs.take_fill(clock["t"])
+        oracle_gaps += clock["t"] - last_idle   # idle closed at dispatch
+        seq = obs.on_dispatch(t_fill, clock["t"], 4, AF, 8, 0, 0)
+        clock["t"] += device
+        obs.on_device_done(seq, clock["t"])
+        oracle_busy += device
+        last_idle = clock["t"]
+        clock["t"] += drain
+        obs.on_scatter_done(seq, clock["t"])
+
+    acct = obs.account()
+    assert acct["open_waves"] == 0
+    assert acct["span_s"] == pytest.approx(last_idle - t_start, abs=1e-12)
+    assert acct["busy_s"] == pytest.approx(oracle_busy, abs=1e-12)
+    assert sum(acct["bubble_s"].values()) == pytest.approx(
+        oracle_gaps, abs=1e-12)
+    # the closure pin: every second attributed, none twice
+    assert acct["attributed_s"] == pytest.approx(acct["span_s"], abs=1e-9)
+
+
+def test_account_closed_with_overlapping_waves():
+    """Depth-2 shape: two waves overlap on device — busy time is the
+    union (no double count), and the ledger still closes exactly."""
+    obs, clock = make_obs()
+    t_start = clock["t"]
+    obs.note_fill_start(clock["t"])
+    clock["t"] += 0.004
+    t_fill = obs.take_fill(clock["t"])
+    s1 = obs.on_dispatch(t_fill, clock["t"], 4, AF, 8, 0, 0)
+    clock["t"] += 0.010               # wave 2 dispatches mid-flight
+    obs.note_fill_start(clock["t"])
+    clock["t"] += 0.002
+    t_fill2 = obs.take_fill(clock["t"])
+    s2 = obs.on_dispatch(t_fill2, clock["t"], 4, AF, 8, 1, 0)
+    clock["t"] += 0.020
+    obs.on_device_done(s1, clock["t"])
+    clock["t"] += 0.015
+    obs.on_device_done(s2, clock["t"])  # busy 0.004 .. now, one interval
+    t_idle = clock["t"]
+    clock["t"] += 0.003
+    obs.on_scatter_done(s1, clock["t"])
+    obs.on_scatter_done(s2, clock["t"])
+    acct = obs.account()
+    assert acct["open_waves"] == 0
+    assert acct["busy_s"] == pytest.approx(t_idle - t_start - 0.004,
+                                           abs=1e-12)
+    assert acct["bubble_s"]["fill_slow"] == pytest.approx(0.004, abs=1e-12)
+    assert acct["attributed_s"] == pytest.approx(acct["span_s"], abs=1e-9)
+
+
+# ============================================ unit: bubble attribution
+def test_bubble_cause_fill_slow_vs_queue_empty():
+    """The fill-geometry split: a gap dominated by batching time is
+    fill_slow; a gap dominated by no-work time is queue_empty."""
+    obs, clock = make_obs()
+    run_wave(obs, clock)              # establish an idle edge
+    # long fill, short empty → fill_slow
+    clock["t"] += 0.001
+    run_wave(obs, clock, fill_wait=0.050)
+    assert obs.account()["bubble_n"]["fill_slow"] >= 1
+    # long empty, short fill → queue_empty
+    before = obs.account()["bubble_n"]["queue_empty"]
+    clock["t"] += 0.200
+    run_wave(obs, clock, fill_wait=0.001)
+    assert obs.account()["bubble_n"]["queue_empty"] == before + 1
+
+
+def test_bubble_cause_flags_and_priority():
+    """Explicit pipeline events outrank the fill geometry, and retry
+    outranks everything (the failure owns the gap it opened)."""
+    obs, clock = make_obs()
+    run_wave(obs, clock)
+    clock["t"] += 0.010
+    obs.note_backpressure()
+    run_wave(obs, clock, fill_wait=0.001)
+    assert obs.account()["bubble_n"]["drain_backpressure"] == 1
+    clock["t"] += 0.010
+    obs.note_launch_retry()
+    obs.note_backpressure()           # retry wins the tie
+    run_wave(obs, clock, fill_wait=0.001)
+    assert obs.account()["bubble_n"]["launch_retry"] == 1
+    assert obs.account()["bubble_n"]["drain_backpressure"] == 1
+
+
+def test_bubble_cause_reshard_swap_and_cache_served():
+    obs, clock = make_obs()
+    run_wave(obs, clock, gen=0)
+    clock["t"] += 0.010
+    run_wave(obs, clock, gen=3)       # generation moved between waves
+    assert obs.account()["bubble_n"]["reshard_swap"] == 1
+    clock["t"] += 0.010
+    obs.note_cache_served(clock["t"] - 0.001, 5)
+    clock["t"] += 0.005
+    run_wave(obs, clock, gen=3)
+    assert obs.account()["bubble_n"]["cache_served"] == 1
+    # flags are one-shot: the next gap classifies fresh
+    clock["t"] += 0.200
+    run_wave(obs, clock, gen=3, fill_wait=0.001)
+    assert obs.account()["bubble_n"]["queue_empty"] >= 1
+
+
+def test_bubble_histograms_and_top_cause_gauge():
+    reg = telemetry.MetricsRegistry()
+    clock = {"t": 50.0}
+    obs = PipelineObservatory(PipelineObservatoryConfig(), registry=reg,
+                              clock=lambda: clock["t"])
+    run_wave(obs, clock)              # idle edge at device_done, then
+    clock["t"] += 1.0                 # 0.002 drain + 1.0 + 0.001 fill
+    run_wave(obs, clock, fill_wait=0.001)   # big queue_empty bubble
+    h = reg.histogram("dht_pipeline_bubble_seconds", cause="queue_empty")
+    assert h.count == 1 and h.sum == pytest.approx(1.003, abs=1e-9)
+    g = reg.gauge("dht_pipeline_bubble_top_cause")
+    assert g.value == BUBBLE_CAUSES.index("queue_empty")
+
+
+# ======================================= unit: occupancy and overlap
+def test_occupancy_windows_on_frame_checkpoints():
+    """Checkpoints bound the occupancy window: an idle boot hour ages
+    out once frames advance past window_s — the gauge reports current
+    behaviour, not lifetime history."""
+    obs, clock = make_obs(window_s=10.0)
+    run_wave(obs, clock, device=1.0)  # 1 s busy...
+    clock["t"] += 100.0               # ...then a long dark age
+    obs.on_frame()
+    lifetime = obs.occupancy()
+    assert lifetime is not None and lifetime < 0.02
+    # a fully-busy recent window, checkpointed each "frame"
+    for _ in range(10):
+        run_wave(obs, clock, device=1.0, drain=0.0)
+        obs.on_frame()
+    occ = obs.occupancy()
+    assert occ is not None and occ > 0.9, occ
+
+
+def test_occupancy_gauge_unknown_until_first_wave():
+    reg = telemetry.MetricsRegistry()
+    obs = PipelineObservatory(PipelineObservatoryConfig(), registry=reg)
+    assert reg.gauge("dht_pipeline_occupancy").value == -1.0
+    assert obs.occupancy() is None
+
+
+def test_overlap_ratio_serial_vs_pipelined():
+    """Serial waves sweep to ~1.0; overlapped spans exceed 1.0 — the
+    always-on successor to the one-shot pipeline_overlap capture."""
+    obs, clock = make_obs()
+    for _ in range(3):
+        run_wave(obs, clock, fill_wait=0.001)
+        clock["t"] += 0.001
+    obs.on_frame()
+    serial = obs.snapshot()["overlap_ratio"]
+    assert 0.9 <= serial <= 1.01, serial
+
+    obs2, clock2 = make_obs()
+    # two waves whose [fill, done] spans overlap heavily
+    obs2.note_fill_start(clock2["t"])
+    t_f = obs2.take_fill(clock2["t"])
+    s1 = obs2.on_dispatch(t_f, clock2["t"], 4, AF, 8, 0, 0)
+    clock2["t"] += 0.005
+    obs2.note_fill_start(clock2["t"])
+    t_f2 = obs2.take_fill(clock2["t"])
+    s2 = obs2.on_dispatch(t_f2, clock2["t"], 4, AF, 8, 1, 0)
+    clock2["t"] += 0.050
+    obs2.on_device_done(s1, clock2["t"])
+    obs2.on_device_done(s2, clock2["t"])
+    obs2.on_scatter_done(s1, clock2["t"])
+    obs2.on_scatter_done(s2, clock2["t"])
+    assert obs2.snapshot()["overlap_ratio"] > 1.5
+
+
+# ============================================== unit: collapse signal
+def test_collapse_unknown_then_tracks_starved_share():
+    obs, clock = make_obs()
+    assert obs.collapse() is None     # no baseline yet
+    run_wave(obs, clock)
+    clock["t"] += 0.010
+    obs.note_launch_retry()
+    run_wave(obs, clock, fill_wait=0.001)
+    clock["t"] += 0.010
+    v = obs.collapse()
+    assert v is not None and 0.0 < v <= 1.0
+    # a quiet window is unknown, never healthy-by-default
+    clock["t"] += 5.0
+    assert obs.collapse() is None
+
+
+def test_collapse_ignores_healthy_idleness():
+    """queue_empty / cache_served are not starvation: a trickle-load
+    window full of them reports ~0, not a degrade."""
+    assert set(STARVED_CAUSES).isdisjoint({"queue_empty", "cache_served"})
+    obs, clock = make_obs()
+    obs.collapse()                    # arm the baseline
+    run_wave(obs, clock)
+    clock["t"] += 1.0
+    run_wave(obs, clock, fill_wait=0.001)   # queue_empty bubble
+    v = obs.collapse()
+    assert v == pytest.approx(0.0, abs=1e-9)
+
+
+# =========================================== unit: lane export surface
+def test_lane_records_one_pid_per_lane_and_span_links():
+    from opendht_tpu import tracing
+    obs, clock = make_obs()
+    seq = run_wave(obs, clock)
+    obs.on_scatter_done(seq, clock["t"])  # idempotent: already closed
+    # a second wave closed with a linked dht.search.wave span
+    clock["t"] += 0.010
+    obs.note_fill_start(clock["t"])
+    t_f = obs.take_fill(clock["t"])
+    s2 = obs.on_dispatch(t_f, clock["t"], 4, AF, 8, 1, 2)
+    clock["t"] += 0.020
+    obs.on_device_done(s2, clock["t"])
+    obs.on_scatter_done(s2, clock["t"], trace="ab" * 16, span="cd" * 8)
+
+    recs = obs.lane_records()
+    assert {r["node"] for r in recs} == \
+        {"lane:fill", "lane:device", "lane:drain"}
+    by_wave = {}
+    for r in recs:
+        by_wave.setdefault(r["attrs"]["wave_seq"], []).append(r)
+    assert all(len(v) == 3 for v in by_wave.values())
+    linked = [r for r in recs if r["attrs"]["wave_seq"] == s2]
+    assert all(r["attrs"]["wave_trace_id"] == "ab" * 16 for r in linked)
+    assert all(r["attrs"]["reshard_gen"] == 2 for r in linked)
+    # span ids are unique across lanes; trace groups a wave's slices
+    assert len({r["span_id"] for r in recs}) == len(recs)
+
+    trace = obs.chrome_trace()
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == len(recs)
+    meta = {e["args"]["name"]: e["pid"]
+            for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"lane:fill", "lane:device", "lane:drain"} <= set(meta)
+    assert len({meta[n] for n in
+                ("lane:fill", "lane:device", "lane:drain")}) == 3
+    assert tracing is not None
+
+
+def test_cache_served_wave_exports_fill_lane_only():
+    obs, clock = make_obs()
+    obs.note_fill_start(clock["t"])
+    clock["t"] += 0.004
+    t_f = obs.take_fill(clock["t"])
+    obs.note_cache_served(t_f, 7)
+    recs = obs.lane_records()
+    assert [r["node"] for r in recs] == ["lane:fill"]
+    assert recs[0]["attrs"]["cache_served"] is True
+    assert recs[0]["attrs"]["entries"] == 7
+
+
+def test_ring_bounded_and_disabled_is_noop():
+    obs, clock = make_obs(ring=4)
+    for _ in range(10):
+        run_wave(obs, clock, fill_wait=0.001)
+        clock["t"] += 0.001
+    assert obs.snapshot()["ring"] == 4
+
+    reg = telemetry.MetricsRegistry()
+    off = PipelineObservatory(PipelineObservatoryConfig(enabled=False),
+                              registry=reg)
+    off.note_fill_start(1.0)
+    assert off.take_fill(2.0) is None
+    assert off.on_dispatch(None, 2.0, 4, AF, 8, 0, 0) == -1
+    off.on_device_done(-1, 3.0)
+    off.on_scatter_done(-1, 3.0)
+    assert off.snapshot() == {"enabled": False}
+    assert off.occupancy() is None and off.collapse() is None
+    assert reg.gauge("dht_pipeline_occupancy").value == -1.0
+
+
+# ============================== integration: failure-path lifecycles
+DEPTHS = (1, 2, 4)
+
+
+def _obs_of(dht):
+    return dht.wave_builder.observatory
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_launch_retry_requeue_closes_lanes(depth):
+    """A consume failure requeues the entries — the failed wave's lane
+    slices must close (no orphan open intervals) and the retry wave's
+    idle gap is attributed launch_retry."""
+    clock = {"t": 30_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002,
+                   ingest_pipeline_depth=depth)
+    handles = fake_launch(dht, ok=True, fail=True)   # consume raises
+    got = []
+    for name in ("lr-a", "lr-b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    _pump(dht, clock)
+    obs = _obs_of(dht)
+    assert len(obs._open) == 0, "failed wave leaked an open interval"
+    acct = obs.account()
+    assert acct["open_waves"] == 0
+    # let the retry wave through
+    for h in handles:
+        h.fail = False
+    for _ in range(4):
+        _pump(dht, clock)
+    assert sorted(got) == ["lr-a", "lr-b"]
+    assert len(obs._open) == 0
+    assert obs.account()["bubble_n"]["launch_retry"] >= 1
+    # the ledger still closes across the failure
+    a = obs.account()
+    assert a["attributed_s"] == pytest.approx(a["span_s"], abs=1e-6)
+
+
+@pytest.mark.parametrize("depth", (2, 4))
+def test_mid_drain_device_error_closes_lanes(depth):
+    """Wave N−1 dies at consume while wave N is still in flight: the
+    dead wave's slices close at the requeue, the live wave's at its
+    own scatter — the timeline never holds an orphan."""
+    clock = {"t": 31_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002,
+                   ingest_pipeline_depth=depth)
+    handles = fake_launch(dht)
+    got = []
+    for name in ("md-1a", "md-1b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    dht.scheduler.run()
+    for name in ("md-2a", "md-2b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    _pump(dht, clock)
+    assert len(handles) == 2
+    obs = _obs_of(dht)
+    assert len(obs._open) == 2        # both legitimately in flight
+    handles[0].fail = True            # wave 1 dies mid-drain
+    handles[1].ok = True
+    _pump(dht, clock)
+    assert got == ["md-2a", "md-2b"]
+    assert len(obs._open) == 0, "mid-drain failure leaked an interval"
+    for _ in range(4):
+        # flip EVERY handle each pump — the retry wave makes new ones
+        for h in handles:
+            h.ok, h.fail = True, False
+        _pump(dht, clock)
+    assert sorted(got) == ["md-1a", "md-1b", "md-2a", "md-2b"]
+    assert len(obs._open) == 0
+    assert obs.account()["bubble_n"]["launch_retry"] >= 1
+
+
+class _FakeLayout:
+    def __init__(self, gen):
+        self.gen = gen
+
+
+class _FakeReshard:
+    def __init__(self, gen):
+        self.layout = _FakeLayout(gen)
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_reshard_swap_between_waves_attributed(depth):
+    """A boundary-generation hot swap between waves owns the idle gap
+    it opens: the next dispatch classifies reshard_swap, and both
+    waves' lanes close normally."""
+    clock = {"t": 32_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002,
+                   ingest_pipeline_depth=depth)
+    fake_launch(dht, ok=True)
+    dht.reshard = _FakeReshard(0)
+    got = []
+    for name in ("rs-1a", "rs-1b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    for _ in range(3):
+        _pump(dht, clock)
+    obs = _obs_of(dht)
+    assert len(obs._open) == 0
+    dht.reshard = _FakeReshard(5)     # hot swap between waves
+    for name in ("rs-2a", "rs-2b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes, n=name: got.append(n))
+    for _ in range(3):
+        _pump(dht, clock)
+    assert sorted(got) == ["rs-1a", "rs-1b", "rs-2a", "rs-2b"]
+    assert len(obs._open) == 0
+    assert obs.account()["bubble_n"]["reshard_swap"] == 1
+    ring = [w for w in obs._ring if w.gen == 5]
+    assert ring and all(w.t_done >= w.t_avail >= w.t_dispatch
+                        for w in ring)
+
+
+# ===================== satellite 2: windowed in-flight peak regression
+def test_inflight_peak_windows_on_frame_tick():
+    """The peak gauge must report the high-water of the CURRENT
+    history-frame window (max of the two live windows so it never
+    blinks to 0 at a frame edge), not a boot-time spike forever."""
+    clock = {"t": 33_000.0}
+    dht = make_dht(clock, ingest_fill_target=2, ingest_deadline=0.002,
+                   ingest_pipeline_depth=2)
+    handles = fake_launch(dht)
+    reg = telemetry.get_registry()
+    g = reg.gauge("dht_ingest_pipeline_inflight_peak")
+    for name in ("pk-1a", "pk-1b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes: None)
+    dht.scheduler.run()
+    for name in ("pk-2a", "pk-2b"):
+        dht.wave_builder.submit(InfoHash.get(name), AF, SEARCH_NODES,
+                                lambda nodes: None)
+    _pump(dht, clock)
+    assert dht.wave_builder.inflight_peak == 2
+    assert g.value == 2.0
+    for h in handles:
+        h.ok = True
+    _pump(dht, clock)                 # drained: inflight back to 0
+    # first frame edge: previous window's peak (2) still visible
+    dht.wave_builder.frame_tick()
+    assert g.value == 2.0
+    assert dht.wave_builder.pipeline_snapshot()["inflight_peak"] == 2
+    # second frame edge with no new waves: the spike has aged out
+    dht.wave_builder.frame_tick()
+    assert g.value == 0.0
+    assert dht.wave_builder.snapshot()["inflight_peak"] == 0
+    # and frame_tick feeds the observatory's occupancy checkpoints
+    assert len(_obs_of(dht)._ckpts) >= 2
+
+
+def test_history_frame_hook_drives_frame_tick():
+    """runner.py wires WaveBuilder.frame_tick as a history frame hook;
+    the History side of that seam: hooks fire once per committed frame
+    and a raising hook is swallowed (observability never kills the
+    recorder)."""
+    from opendht_tpu.history import MetricsHistory
+
+    reg = telemetry.MetricsRegistry()
+    clock = {"t": 40_000.0}
+    h = MetricsHistory(registry=reg, clock=lambda: clock["t"])
+    seen = []
+    h.add_frame_hook(lambda frame: seen.append(frame))
+    h.add_frame_hook(lambda frame: 1 / 0)   # must not break the tick
+    reg.counter("dht_test_ticks_total").inc()
+    h.tick()                          # first tick: baseline, no frame
+    assert seen == []
+    clock["t"] += 1.0
+    reg.counter("dht_test_ticks_total").inc()
+    frame = h.tick()
+    assert frame is not None
+    assert len(seen) == 1 and seen[0] is frame
+    clock["t"] += 1.0
+    h.tick()
+    assert len(seen) == 2
+
+
+# ========================================= health signal registration
+def test_health_signal_registered_degrade_only():
+    from opendht_tpu.health import DEFAULT_SIGNAL_THRESHOLDS, HealthConfig
+    assert "pipeline_occupancy" in DEFAULT_SIGNAL_THRESHOLDS
+    lo, hi = DEFAULT_SIGNAL_THRESHOLDS["pipeline_occupancy"]
+    assert 0.0 < lo < hi <= 1.0
+    assert "pipeline_occupancy" in HealthConfig().degrade_only

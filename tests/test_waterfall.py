@@ -19,8 +19,8 @@ from opendht_tpu.runtime.live_search import SEARCH_NODES
 from opendht_tpu.scheduler import Scheduler
 from opendht_tpu.sockaddr import SockAddr
 from opendht_tpu.waterfall import (DEFAULT_STAGE_BUDGETS, OPEN_BOUND_KEYS,
-                                   STAGES, OpenBoundTracker, StageProfiler,
-                                   WaterfallConfig)
+                                   STAGE_ALIASES, STAGES, OpenBoundTracker,
+                                   StageProfiler, WaterfallConfig)
 
 AF = _socket.AF_INET
 
@@ -99,13 +99,15 @@ def test_record_op_ring_bounded():
 def test_folded_flamegraph_lines():
     p = _profiler()
     p.observe("queue_wait", 0.001)
-    p.observe("device_launch", 0.005)
+    p.observe("device_launch", 0.005)    # alias lands in device_wait
     out = p.folded()
     assert out.endswith("\n")
     for ln in out.strip().splitlines():
         assert re.fullmatch(r"dht;op;[a-z_]+ \d+", ln), ln
     assert "dht;op;queue_wait 1000" in out
-    assert "dht;op;device_launch 5000" in out
+    # folded emits canonical stages only — the round-22 alias resolves
+    assert "dht;op;device_wait 5000" in out
+    assert "device_launch" not in out
     assert _profiler().folded() == ""    # nothing observed, nothing folded
 
 
@@ -165,8 +167,8 @@ def test_wave_stages_advance_and_ops_sum_to_end_to_end():
     dht.scheduler.run()
 
     assert wf._h["queue_wait"].count >= base["queue_wait"] + 4
-    dev = (wf._h["device_compile"].count + wf._h["device_launch"].count
-           - base["device_compile"] - base["device_launch"])
+    dev = (wf._h["device_compile"].count + wf._h["device_wait"].count
+           - base["device_compile"] - base["device_wait"])
     assert dev >= 1
     assert wf._h["scatter_back"].count >= base["scatter_back"] + 1
 
@@ -284,7 +286,9 @@ def test_snapshot_shape_and_quantiles():
         p.observe("rpc_wait", v)
     doc = json.loads(json.dumps(p.snapshot()))   # JSON-able
     assert doc["enabled"] is True
-    assert set(doc["stages"]) == set(STAGES)
+    # canonical stages plus the one-release alias mirror (round 22)
+    assert set(doc["stages"]) == set(STAGES) | set(STAGE_ALIASES)
+    assert doc["stages"]["device_launch"]["alias_of"] == "device_wait"
     rw = doc["stages"]["rpc_wait"]
     assert rw["count"] == 4
     assert rw["p50"] is not None and rw["p99"] >= rw["p50"]
@@ -425,7 +429,7 @@ def test_scanner_snapshot_has_waterfall_and_chaos_sections():
         snap = topology_snapshot(r)
         wfs = snap["waterfall"]
         assert wfs["enabled"] is True
-        assert set(wfs["stages"]) == set(STAGES)
+        assert set(wfs["stages"]) == set(STAGES) | set(STAGE_ALIASES)
         assert "open_bounds" in wfs
         assert set(wfs["open_bounds"]["bounds"]) == set(OPEN_BOUND_KEYS)
         chaos = snap["chaos"]
