@@ -310,7 +310,8 @@ def check_tp_wire(failures):
 #: and both docs must state the bound
 _OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead",
                   "cache_overhead", "history_overhead",
-                  "waterfall_overhead", "pipeutil_overhead")
+                  "waterfall_overhead", "pipeutil_overhead",
+                  "peers_overhead")
 
 
 def check_overhead_captures(failures):
@@ -618,6 +619,56 @@ def check_pipeline_util(failures):
                     f"(Σ(busy) + Σ(bubbles) == observed window)")
 
 
+def check_peer_ledger(failures):
+    """Round-23 rule, BOTH directions: the committed per-peer ledger
+    overhead artifact (``captures/peers_overhead.json``) must itself
+    record a real lifecycle load (at least one full request lifecycle
+    per tracked peer per wave — an empty event stream would make the
+    <1% quote vacuous), and README *and* PARITY must each carry a
+    ``<!-- capture:peers_overhead -->``-tagged paragraph stating the
+    pure-observation claim (wave outputs pinned **bit-identical** with
+    the ledger on) next to the measured quote (the ``<1%`` bound
+    itself rides the generic :func:`check_overhead_captures` rule); a
+    tagged claim without the artifact (or vice versa) fails."""
+    cap_path = os.path.join(ROOT, "captures", "peers_overhead.json")
+    cap = None
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            cap = json.load(f)
+        if cap.get("lifecycles_per_wave", 0) < cap.get("peers", 1):
+            failures.append(
+                "captures/peers_overhead.json: lifecycles_per_wave=%r "
+                "under peers=%r — the timed trips did not drive a full "
+                "lifecycle per tracked peer, the overhead quote is "
+                "vacuous" % (cap.get("lifecycles_per_wave"),
+                             cap.get("peers")))
+    tag = "<!-- capture:peers_overhead -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if cap is None:
+            if tagged:
+                failures.append(f"{name}: '{tag}' claim with no "
+                                f"captures/peers_overhead.json "
+                                f"artifact")
+            continue
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the per-peer ledger overhead "
+                            f"measurement")
+            continue
+        for li in tagged:
+            para = _para_at(lines, li)
+            if "bit-identical" not in para:
+                failures.append(
+                    f"{name}: [capture:peers_overhead] paragraph does "
+                    f"not state the pure-observation claim (wave "
+                    f"outputs bit-identical with the ledger on)")
+
+
 #: the observability index (ISSUE-10 satellite): every serving surface
 #: and the reference counterpart(s) it maps to.  BOTH directions: each
 #: surface must appear as a row of the tagged table in README AND
@@ -626,7 +677,7 @@ def check_pipeline_util(failures):
 OBS_SURFACES = ("GET /stats", "GET /trace", "GET /healthz",
                 "GET /keyspace", "GET /cache", "GET /history",
                 "GET /debug/bundle", "GET /profile", "GET /pipeline",
-                "kernel ledger", "dhtscanner --json")
+                "GET /peers", "kernel ledger", "dhtscanner --json")
 OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /",
                   "DhtRunner::loop_")
 
@@ -753,6 +804,7 @@ def main() -> int:
     check_pipeline_overlap(failures)
     check_reshard_balance(failures)
     check_pipeline_util(failures)
+    check_peer_ledger(failures)
     check_observability_index(failures)
     check_trajectory(failures)
     if failures:

@@ -522,6 +522,44 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "observatory overhead smoke failed"
 PY
+# per-peer observatory smoke (round 23): boot 3-node real-UDP clusters
+# and inject chaos-plane faults on ONE link — the same delay+jitter
+# rule (RTTs straddling the fixed 1.0s timer) runs once with the
+# fixed timetable and once with the adaptive per-peer RTO, and the
+# adaptive run must record measurably fewer spurious retransmits while
+# the untouched link's srtt/RTO stay baseline; then a one-way loss
+# rule on node0->node2 must land on exactly that directed edge of the
+# cluster wire map (testing/wiremap_assembler.py over every node's
+# GET /peers), tick dht_net_attempt_timeouts_total at the EXPIRED
+# transitions, and flip dhtmon --max-peer-fail from 0 to 1 across the
+# injected fail ratio.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.peer_smoke import main
+rc = main()
+assert rc == 0, "per-peer observatory smoke failed"
+PY
+# per-peer ledger overhead smoke (round 23): with 256 synthetic
+# request lifecycles per wave over 32 peers (every completion a clean
+# Karn sample driving the RFC 6298 estimator + per-peer histogram +
+# gauge writes), the search round must stay inside a generous 5% band
+# vs the ledger-disabled run (the committed
+# captures/peers_overhead.json documents the tight number against the
+# <1% acceptance, enforced against the README quote by check_docs
+# above), and the wave outputs stay bit-identical on vs off.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_peers_r23", pathlib.Path("benchmarks/exp_peers_r23.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "per-peer ledger overhead smoke failed"
+PY
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

@@ -214,6 +214,18 @@ DEFAULT_SIGNAL_THRESHOLDS = {
     # an efficiency collapse, not lost liveness.  Unknown (never
     # trips) while the observatory is off or the window saw no waves.
     "pipeline_occupancy": (0.5, 0.9),
+    # round 23 (ISSUE-19): worst single-link fail ratio from the
+    # per-peer ledger (opendht_tpu/peers.py) — expired / finished
+    # requests of the worst peer with at least
+    # Config.peers.min_signal_events requests.  Half the requests to
+    # ONE peer failing degrades; 0.9 would be unhealthy-grade, but the
+    # signal is capped at degraded in the verdict (degrade_only): one
+    # bad link (or one dead remote peer flapping good<->dubious<->
+    # expired) is a wire problem to route around, not lost liveness of
+    # THIS node — the cluster-wide view is already timeout_ratio.
+    # Unknown (never trips) while the ledger is off or no peer
+    # qualifies.
+    "peer_flap": (0.5, 0.9),
 }
 
 
@@ -254,9 +266,11 @@ class HealthConfig:
     #: stage_budget joins it (round 19): a stage past its latency
     #: budget is slow serving, not a down node.  pipeline_occupancy
     #: joins it (round 22): a starved pipeline serves slowly, it is
-    #: not dead.
+    #: not dead.  peer_flap joins it (round 23): ONE bad link is a
+    #: wire problem to route around, not lost liveness of this node.
     degrade_only: tuple = ("shard_imbalance", "cache_hit_ratio",
-                           "stage_budget", "pipeline_occupancy")
+                           "stage_budget", "pipeline_occupancy",
+                           "peer_flap")
 
 
 # ====================================================== window bookkeeping
@@ -744,6 +758,7 @@ class NodeHealth:
                 "cache_hit_ratio": self._cache_hit_ratio,
                 "stage_budget": self._stage_budget,
                 "pipeline_occupancy": self._pipeline_occupancy,
+                "peer_flap": self._peer_flap,
             })
         self._job = None
 
@@ -838,6 +853,20 @@ class NodeHealth:
         if obs is None or not obs.enabled:
             return None
         return obs.collapse()
+
+    def _peer_flap(self) -> Optional[float]:
+        """Worst single-link fail ratio from the round-23 per-peer
+        ledger (opendht_tpu/peers.py): expired / finished requests of
+        the worst peer with at least ``Config.peers.min_signal_events``
+        requests — the per-link view next to the cluster-wide
+        ``timeout_ratio``.  None (unknown, never trips) while the
+        ledger is off or no peer has enough traffic to judge.
+        Degrade-only in the verdict
+        (:class:`HealthConfig`.degrade_only)."""
+        led = getattr(self._dht, "peers", None)
+        if led is None or not getattr(led, "enabled", False):
+            return None
+        return led.fail_signal()
 
     # --------------------------------------------------------------- tick
     def attach(self, scheduler) -> None:

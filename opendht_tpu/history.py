@@ -538,6 +538,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
                  ingest: Optional[dict] = None,
                  waterfall: Optional[dict] = None,
                  pipeline: Optional[dict] = None,
+                 peers: Optional[dict] = None,
                  tracer: Optional[tracing.Tracer] = None,
                  flight_limit: int = 400) -> dict:
     """Assemble one post-mortem black-box bundle (↔ the reference's
@@ -561,6 +562,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
         "ingest": ingest or {},
         "waterfall": waterfall or {},
         "pipeline": pipeline or {},
+        "peers": peers or {},
         "history": {"enabled": False, "frames": []},
         "flight_recorder": {"spans": [], "events": []},
         "kernels": {},

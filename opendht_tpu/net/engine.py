@@ -26,7 +26,7 @@ from ..scheduler import Scheduler
 from ..sockaddr import SockAddr
 from ..utils import DhtException, WANT4, WANT6, pack_msg, wall_now
 from ..core.value import Query, Value, FieldValueIndex
-from .node import MAX_RESPONSE_TIME, Node, SocketCb
+from .node import Node, SocketCb
 from .node_cache import NodeCache
 from .parsed_message import (
     MessageType, ParsedMessage, REQUEST_TYPES, pack_tid, unpack_tid,
@@ -212,6 +212,13 @@ class NetworkEngine:
         # guard.  hook(data, addr) -> True means the hook consumed the
         # packet (dropped, or rescheduled with extra delay).
         self.fault_hook: Optional[Callable[[bytes, SockAddr], bool]] = None
+        # per-peer network observatory (ISSUE-19): optional
+        # peers.PeerLedger attached by runtime.dht.Dht under the
+        # Config.peers guard.  None (the default) leaves the request
+        # lifecycle byte- and timing-identical to pre-round-23 builds;
+        # attached, every request carries the ledger + the peer's
+        # adaptive RTO (MAX_RESPONSE_TIME until RTT samples exist).
+        self.peers = None
 
     def _count_msg(self, direction: str, mtype: str) -> None:
         c = self._m_msgs.get((direction, mtype))
@@ -317,6 +324,11 @@ class NetworkEngine:
         req.start = self.scheduler.time()
         req.node.requested(req)
         self._count_sent(req)
+        peers = self.peers
+        if peers is not None:
+            req.ledger = peers
+            req.rto = peers.rto(req.node)
+            peers.on_send(req.node, req.type.value, len(req.msg))
         self._request_step(req)
 
     def _request_step(self, req: Request) -> None:
@@ -357,6 +369,13 @@ class NetworkEngine:
                     # out (counting here, not at step entry, so EAGAIN
                     # reschedules of the SAME attempt count once)
                     self._m_timeouts.inc()
+                    if req.ledger is not None:
+                        # ISSUE-19: per-peer attempt-timeout + resent
+                        # bytes, then refresh the RTO for the NEXT
+                        # attempt (the estimator may have new samples
+                        # from the peer's other in-flight requests)
+                        req.ledger.on_retransmit(req)
+                        req.rto = req.ledger.rto(node)
                     if self._tracer.enabled:
                         self._tracer.event(
                             "request_timeout", node=self._node_tag,
@@ -364,7 +383,7 @@ class NetworkEngine:
                             attempt=req.attempt_count)
                 req.attempt_count += 1
             req.last_try = now
-            self.scheduler.add(req.last_try + MAX_RESPONSE_TIME,
+            self.scheduler.add(req.last_try + req.rto,
                                lambda: self._request_step(req))
 
     # -------------------------------------------------------- rate limiting
@@ -426,7 +445,7 @@ class NetworkEngine:
             return
 
         if not msg.value_parts:
-            self._process(msg, from_addr)
+            self._process(msg, from_addr, nbytes=len(data))
         elif msg.tid not in self._partials:
             self._partials[msg.tid] = _PartialMessage(from_addr, now, msg)
             self.scheduler.add(now + RX_MAX_PACKET_TIME,
@@ -444,11 +463,17 @@ class NetworkEngine:
                 or pm.last_part + RX_TIMEOUT < now):
             del self._partials[tid]
 
-    def _process(self, msg: ParsedMessage, from_addr: SockAddr) -> None:
-        """Dispatch one complete message (network_engine.cpp:491-633)."""
+    def _process(self, msg: ParsedMessage, from_addr: SockAddr,
+                 nbytes: int = 0) -> None:
+        """Dispatch one complete message (network_engine.cpp:491-633).
+        ``nbytes`` is the raw datagram size for per-peer byte
+        attribution (0 for reassembled multi-part values — the
+        fragments' raw sizes are not retained)."""
         now = self.scheduler.time()
         node = self.cache.get_node(msg.id, from_addr, now, confirm=True,
                                    client=msg.is_client)
+        if self.peers is not None:
+            self.peers.on_received(node, msg.type.value, nbytes)
         # ISSUE-4: an incoming request carrying a sampled wire context
         # records a server span around the whole handler + reply send,
         # parented to the sender's per-hop client span — that link is

@@ -9,10 +9,21 @@ Every terminal transition feeds the telemetry spine: completion counts
 into ``dht_net_requests_completed_total{type=}`` with the request's RTT
 observed into ``dht_net_rtt_seconds{type=}`` (reply_time − start, both
 stamped by the engine on scheduler time), expiry into
-``dht_net_requests_expired_total{type=}``, cancellation into
-``dht_net_requests_cancelled_total{type=}``.  The matching send-side
-counters (sent / per-attempt timeouts) live in
+``dht_net_requests_expired_total{type=}`` (plus the censored-attempt
+counter ``dht_net_attempt_timeouts_total{type=}`` — an expired
+request's attempts all timed out and never reached the RTT histogram,
+so without it loss silently thins the latency surface — ISSUE-19),
+cancellation into ``dht_net_requests_cancelled_total{type=}``.  The
+matching send-side counters (sent / per-attempt timeouts) live in
 :mod:`~opendht_tpu.net.engine`.
+
+Round 23 (ISSUE-19): the engine attaches the per-peer ledger
+(:mod:`~opendht_tpu.peers`) to ``ledger`` at send time and stamps
+``rto`` with the peer's adaptive retransmit timeout (exactly
+``MAX_RESPONSE_TIME`` when the knob is off or the peer has no RTT
+samples — the fixed-timeout behaviour pin); terminal transitions
+report back so per-peer completed/expired/cancelled counts and the
+Jacobson/Karels estimator stay attributed per link.
 
 Distributed tracing (ISSUE-4): a request sent under a sampled trace
 context carries the engine-opened per-hop client span in
@@ -67,7 +78,7 @@ class RequestState(enum.Enum):
 class Request:
     __slots__ = ("node", "tid", "type", "msg", "on_done", "on_expired",
                  "socket_id", "state", "attempt_count", "start", "last_try",
-                 "reply_time", "trace_span")
+                 "reply_time", "trace_span", "rto", "ledger")
 
     def __init__(self, msg_type: "MessageType", tid: int, node: Node,
                  msg: bytes,
@@ -87,6 +98,12 @@ class Request:
         self.last_try = _NEVER
         self.reply_time = _NEVER
         self.trace_span = trace_span      # per-hop client span (ISSUE-4)
+        # per-attempt retransmit timeout; the engine overwrites it from
+        # the peer ledger when Config.peers.adaptive_rto is on, and the
+        # ledger default keeps it at the reference's fixed value
+        # (ISSUE-19)
+        self.rto = MAX_RESPONSE_TIME
+        self.ledger = None                # peers.PeerLedger, set at send
 
     # -- state predicates --------------------------------------------------
     @property
@@ -112,10 +129,12 @@ class Request:
     def is_expired(self, now: float) -> bool:
         """All attempts used and the last one timed out (request.h:110-112).
         ``>=``, not ``>``: retries are scheduled at exactly
-        last_try + MAX_RESPONSE_TIME, and discrete-event drivers land on
-        that instant — strict compare would retry dead nodes forever."""
+        last_try + rto, and discrete-event drivers land on that
+        instant — strict compare would retry dead nodes forever.
+        ``rto`` is the per-peer adaptive timeout when enabled and is
+        pinned to ``MAX_RESPONSE_TIME`` otherwise (ISSUE-19)."""
         return (self.pending
-                and now >= self.last_try + MAX_RESPONSE_TIME
+                and now >= self.last_try + self.rto
                 and self.attempt_count >= MAX_ATTEMPT_COUNT)
 
     # -- transitions (request.h:88-105) ------------------------------------
@@ -132,6 +151,16 @@ class Request:
             self.state = RequestState.EXPIRED
             _metric("counter", "dht_net_requests_expired_total",
                     self.type).inc()
+            # ISSUE-19 satellite: every attempt of an expired request
+            # timed out without reaching dht_net_rtt_seconds — count
+            # the censored attempts so loss shows up next to RTT
+            # instead of silently thinning the histogram (a request
+            # expired before any attempt — node.set_expired — still
+            # censors one solicited answer)
+            _metric("counter", "dht_net_attempt_timeouts_total",
+                    self.type).inc(max(self.attempt_count, 1))
+            if self.ledger is not None:
+                self.ledger.on_request_expired(self)
             tr = tracing.get_tracer()
             if tr.enabled:
                 tr.event("request_expired", type=self.type.value,
@@ -146,6 +175,7 @@ class Request:
             self.state = RequestState.COMPLETED
             _metric("counter", "dht_net_requests_completed_total",
                     self.type).inc()
+            rtt = None
             if self.reply_time != _NEVER and self.start != _NEVER:
                 rtt = max(self.reply_time - self.start, 0.0)
                 _metric("histogram", "dht_net_rtt_seconds", self.type) \
@@ -162,6 +192,8 @@ class Request:
                     wf.observe("rpc_wait", rtt,
                                exemplar=(sp.ctx.trace_hex
                                          if sp is not None else None))
+            if self.ledger is not None:
+                self.ledger.on_request_completed(self, rtt)
             self._finish_span("completed")
             if self.on_done:
                 self.on_done(self, msg)
@@ -172,6 +204,8 @@ class Request:
             self.state = RequestState.CANCELLED
             _metric("counter", "dht_net_requests_cancelled_total",
                     self.type).inc()
+            if self.ledger is not None:
+                self.ledger.on_request_cancelled(self)
             tr = tracing.get_tracer()
             if tr.enabled:
                 tr.event("request_cancelled", type=self.type.value,
@@ -188,6 +222,7 @@ class Request:
         self.on_done = None
         self.on_expired = None
         self.msg = b""
+        self.ledger = None
 
     def state_char(self) -> str:
         return {"pending": "f", "cancelled": "c", "expired": "e",

@@ -519,6 +519,19 @@ def _make_handler(server: DhtProxyServer):
                 # get_pipeline already degrades to {"enabled": False}
                 self._send_json(runner.get_pipeline())
                 return
+            if parts == ["peers"]:
+                # GET /peers → the per-peer network observatory
+                # (round 23, ISSUE-19): per-peer srtt/rttvar/RTO,
+                # request outcome counts, attempt timeouts + spurious
+                # retransmits, bytes by message type and status flap
+                # transitions — the wire-map assembler's scrape
+                # surface.  "peers" is not a valid hash, so — like
+                # /stats — the path was previously a 400 and stays
+                # unambiguous.
+                # get_peers already degrades to {"enabled": False} on
+                # any internal failure — no second wrapper here
+                self._send_json(runner.get_peers())
+                return
             if parts[0] == "trace":
                 # GET /trace[?name=] → the node's flight-recorder dump
                 # (ISSUE-4; the reference's dumpTables as a scrapeable

@@ -18,6 +18,7 @@ from ..hotcache import HotCacheConfig  # noqa: F401  (same knob-surface rule)
 from ..waterfall import WaterfallConfig  # noqa: F401  (same knob-surface rule)
 from ..reshard import ReshardConfig  # noqa: F401  (same knob-surface rule)
 from ..pipeline_observatory import PipelineObservatoryConfig  # noqa: F401,E501  (same knob-surface rule)
+from ..peers import PeersConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -220,6 +221,26 @@ class Config:
     #: False`` turns every hook into an early return.
     pipeline: PipelineObservatoryConfig = field(
         default_factory=PipelineObservatoryConfig)
+
+    # --- per-peer network observatory (round 23, opendht_tpu/peers.py) --
+    #: bounded LRU ledger over remote peers fed from the request
+    #: lifecycle: Jacobson/Karels RTT EWMA + variance per peer,
+    #: per-peer sent/completed/timeout/cancel counts, bytes in/out by
+    #: message type and good<->dubious<->expired flap transitions
+    #: mirroring the reference's ``net::Node`` liveness rules.
+    #: ``peers.adaptive_rto`` (off by default) closes the loop into
+    #: the retransmit timer: per-attempt timeout = srtt + 4*rttvar
+    #: clamped to [rto_min, rto_max], pinned exactly
+    #: ``MAX_RESPONSE_TIME`` while a peer has no RTT samples.
+    #: Surfaces: ``dht_peer_*`` series, proxy ``GET /peers``, the
+    #: ``peers`` REPL cmd, the scanner's ``peers`` section, ``dhtmon
+    #: --max-peer-fail``, the degrade-only ``peer_flap`` health signal
+    #: and the testing/wiremap_assembler.py cluster wire map.
+    #: ``peers.enabled = False`` removes every hook — the request
+    #: lifecycle is then byte- and timing-identical to pre-round-23
+    #: builds (the ledger only observes; wire bytes are pinned
+    #: bit-identical either way in benchmarks/exp_peers_r23.py).
+    peers: PeersConfig = field(default_factory=PeersConfig)
 
 
 @dataclass

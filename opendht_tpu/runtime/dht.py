@@ -259,6 +259,20 @@ class Dht:
             on_swap=self._reshard_apply, clock=self.scheduler.time)
         self.reshard.attach(self.scheduler)
 
+        # per-peer network observatory (round 23, ISSUE-19): bounded
+        # LRU ledger over remote peers — Jacobson/Karels RTT estimator,
+        # per-peer request/byte/flap attribution, and (behind
+        # config.peers.adaptive_rto) the per-peer retransmit timeout
+        # the engine consults instead of the fixed MAX_RESPONSE_TIME
+        # (peers.py; config.peers knobs).  Attached to the engine's
+        # request lifecycle seams; a disabled ledger detaches entirely
+        # (engine.peers = None, the pre-round-23 fast path).
+        from ..peers import PeerLedger
+        self.peers = PeerLedger(
+            getattr(config, "peers", None), node=str(self.myid),
+            clock=self.scheduler.time)
+        self.engine.peers = self.peers if self.peers.enabled else None
+
         # per-op latency waterfall (round 19, ISSUE-15): the always-on
         # stage profiler every serving layer feeds (wave builder,
         # search envelope, net engine/request) — process-global like

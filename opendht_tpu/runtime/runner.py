@@ -952,6 +952,7 @@ class DhtRunner:
             ingest=ingest,
             waterfall=self.get_profile(),
             pipeline=self.get_pipeline(),
+            peers=self.get_peers(),
         )
 
     def get_bundles(self) -> list:
@@ -1049,6 +1050,23 @@ class DhtRunner:
             if wb is None:
                 return {"enabled": False}
             return wb.pipeline_snapshot()
+        except Exception:
+            return {"enabled": False}
+
+    def get_peers(self) -> dict:
+        """The per-peer network observatory snapshot (ISSUE-19):
+        per-peer srtt/rttvar/RTO, request outcome counts, attempt
+        timeouts + spurious retransmits, bytes in/out by message type
+        and good<->dubious<->expired flap transitions — the JSON the
+        proxy's ``GET /peers`` route serves, the ``peers`` REPL
+        command prints, the scanner's ``peers`` section embeds and
+        ``testing/wiremap_assembler.py`` folds into the cluster wire
+        map."""
+        try:
+            led = getattr(self._dht, "peers", None)
+            if led is None:
+                return {"enabled": False}
+            return led.snapshot()
         except Exception:
             return {"enabled": False}
 

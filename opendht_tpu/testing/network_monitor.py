@@ -20,6 +20,16 @@ percentile: ``--alert p95=2.5`` (repeatable) exits non-zero as soon as
 the cumulative percentile crosses the threshold, so one flag drives
 pager policy off whichever tail matters.
 
+Round 23 (ISSUE-19): the monitor's latency view is no longer
+roundtrip-only — each round also folds the per-peer
+``dht_peer_rtt_seconds{peer=}`` histograms the round-23 ledger
+maintains (one shared instrumentation point; the monitor adds no
+private wire-RTT bookkeeping) into cumulative per-hop wire
+percentiles, and names the slowest link by smoothed RTT.  The
+end-to-end roundtrip and the wire RTT bracket the same probe: a slow
+round with fast wire RTTs is storage/propagation, a slow round with
+one slow link is that link.
+
 Usage::
 
     python -m opendht_tpu.testing.network_monitor --local -n 4 --rounds 3
@@ -122,6 +132,42 @@ class Monitor:
         the ``dht_monitor_roundtrip_seconds`` histogram."""
         return {p: self.rtt.quantile(p / 100.0) for p in pcts}
 
+    def wire_percentiles(self, pcts=(50, 95)) -> dict:
+        """Cumulative per-hop wire-RTT percentiles folded over EVERY
+        ``dht_peer_rtt_seconds{peer=}`` histogram the round-23 per-peer
+        ledger maintains (merged bucket-exactly — the same log buckets,
+        one summed map) — the monitor reuses the ledger's
+        instrumentation instead of keeping a wire view of its own.
+        All-None when no ledger sample exists yet."""
+        merged: dict = {}
+        total = 0
+        for m in telemetry.get_registry().series(
+                "dht_peer_rtt_seconds").values():
+            cnt, _s, buckets = m.raw()
+            total += cnt
+            for i, c in buckets.items():
+                merged[i] = merged.get(i, 0) + c
+        if total <= 0:
+            return {p: None for p in pcts}
+        items = sorted(merged.items())
+        return {p: telemetry.quantile_from_buckets(items, total, p / 100.0)
+                for p in pcts}
+
+    def worst_link(self):
+        """``(peer_label, srtt_seconds)`` of the slowest tracked link
+        by smoothed RTT across both probe nodes' ledgers; None before
+        any link has an RTT sample."""
+        worst = None
+        for node in (self.node1, self.node2):
+            snap = node.get_peers()
+            if not snap.get("enabled"):
+                continue
+            for pd in snap.get("peers", []):
+                if pd.get("srtt") is not None and (
+                        worst is None or pd["srtt"] > worst[1]):
+                    worst = (pd["peer"], pd["srtt"])
+        return worst
+
     def close(self) -> None:
         self.node1.join()
         self.node2.join()
@@ -176,6 +222,14 @@ def main(argv=None) -> int:
                   "Test completed successfully in", round(dt, 3),
                   "| round-trip " + " ".join(
                       "p%g=%.3fs" % (p, v) for p, v in sorted(pcts.items())))
+            wire = mon.wire_percentiles()
+            if any(v is not None for v in wire.values()):
+                wl = mon.worst_link()
+                print("  wire RTT " + " ".join(
+                    "p%g=%.3fs" % (p, v)
+                    for p, v in sorted(wire.items()) if v is not None)
+                    + (" | slowest link %s srtt=%.3fs" % wl
+                       if wl is not None else ""))
             breaches = percentile_breaches(
                 lambda q: mon.rtt.quantile(q), alerts)
             if breaches:

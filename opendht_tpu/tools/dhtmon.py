@@ -75,7 +75,8 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                max_imbalance: Optional[float] = None,
                min_cache_hit: Optional[float] = None,
                max_stage: Optional[dict] = None,
-               min_occupancy: Optional[float] = None) -> tuple:
+               min_occupancy: Optional[float] = None,
+               max_peer_fail: Optional[float] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
     is the JSON-able cluster report and ``violations`` is a list of
     human-readable invariant failures (empty = healthy).
@@ -127,7 +128,17 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     of wall clock with >= 1 wave in flight on the device) must not
     drop below it — the SAME unknown contract as the other gauge
     gates: a -1/absent gauge (observatory off, or no window closed
-    yet) never violates."""
+    yet) never violates.
+
+    ``max_peer_fail`` gates the round-23 per-peer ledger: the worst
+    single link's ``dht_peer_fail_ratio{peer=}`` gauge (expired /
+    finished requests of one peer, published only past
+    ``Config.peers.min_signal_events`` requests) must not exceed it
+    across any node — the per-LINK view next to the cluster-wide
+    timeout ratio, so one dying link cannot hide inside healthy
+    aggregates.  The SAME unknown contract as ``--max-imbalance``: a
+    -1/absent gauge (ledger off, peer evicted, or too little traffic
+    to judge) never violates."""
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
@@ -277,6 +288,32 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                        key=lambda p: p["occupancy"]
                        if p["occupancy"] is not None else 2.0)
                    ["endpoint"]))
+    if max_peer_fail is not None and scrapes:
+        # per-node, worst = MAX over that node's per-peer fail-ratio
+        # gauges: the gate is "no single link is silently dying" —
+        # -1/absent = unknown (ledger off / evicted peer / below
+        # min_signal_events), never a violation, matching the other
+        # gauge gates.  The gauge name prefix matches every peer label
+        # series of dht_peer_fail_ratio.
+        per_node = []
+        for s in scrapes:
+            vals = [v for name, v in s["series"].items()
+                    if name.startswith("dht_peer_fail_ratio")
+                    and v >= 0]
+            per_node.append({"endpoint": s["endpoint"],
+                             "peer_fail": max(vals) if vals else None})
+        known = [p["peer_fail"] for p in per_node
+                 if p["peer_fail"] is not None]
+        worst = max(known) if known else None
+        doc["peer_fail"] = {"max": worst, "per_node": per_node}
+        if worst is not None and worst > max_peer_fail:
+            violations.append(
+                "peer fail ratio %.3f exceeds %.3f (worst node %s)"
+                % (worst, max_peer_fail,
+                   max(per_node,
+                       key=lambda p: p["peer_fail"]
+                       if p["peer_fail"] is not None else -1.0)
+                   ["endpoint"]))
     if max_stage and scrapes:
         # per-node, worst = MAX p95 per stage: the gate is "no node's
         # serving stage blew its latency budget" — a stage with no
@@ -377,6 +414,16 @@ def main(argv=None) -> int:
                         "below R — unknown (-1/absent: observatory "
                         "off or no closed window) never violates, "
                         "matching the --min-cache-hit contract")
+    p.add_argument("--max-peer-fail", type=float, default=None,
+                   metavar="R",
+                   help="fail when any single link's fail ratio "
+                        "(dht_peer_fail_ratio{peer=}: expired / "
+                        "finished requests to one peer, from the "
+                        "round-23 per-peer ledger) exceeds R on any "
+                        "node — unknown (-1/absent: ledger off, peer "
+                        "evicted, or below Config.peers."
+                        "min_signal_events requests) never violates, "
+                        "matching the --max-imbalance contract")
     p.add_argument("--max-stage", action="append", default=[],
                    metavar="STAGE=SEC",
                    help="fail when any node's p95 for a round-19 "
@@ -425,7 +472,8 @@ def main(argv=None) -> int:
             max_imbalance=args.max_imbalance,
             min_cache_hit=args.min_cache_hit,
             max_stage=max_stage or None,
-            min_occupancy=args.min_occupancy)
+            min_occupancy=args.min_occupancy,
+            max_peer_fail=args.max_peer_fail)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
         return 2
@@ -460,6 +508,11 @@ def main(argv=None) -> int:
         if po:
             print("pipeline occupancy: %s (worst node)" % (
                 "%.4f" % po["min"] if po["min"] is not None
+                else "unknown"))
+        pf = doc.get("peer_fail")
+        if pf:
+            print("peer fail ratio: %s (worst link)" % (
+                "%.3f" % pf["max"] if pf["max"] is not None
                 else "unknown"))
         for stage, w in sorted((doc.get("stages") or {})
                                .get("worst", {}).items()):

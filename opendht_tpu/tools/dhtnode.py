@@ -74,6 +74,14 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            pipeline shape — the same data the proxy
                            serves on GET /pipeline (?fmt=trace there
                            for the Perfetto lane export)
+    peers [json]           per-peer network observatory (round 23):
+                           per-peer srtt/rttvar + adaptive RTO,
+                           request outcome counts, attempt timeouts,
+                           spurious retransmits, bytes by message
+                           type and good<->dubious<->expired flap
+                           transitions — the same data the proxy
+                           serves on GET /peers; 'json' dumps the
+                           full snapshot
     cache [json]           hot-key serving cache (round 16): occupancy,
                            per-entry hit counts, windowed hit ratio,
                            invalidation/eviction totals and the
@@ -439,6 +447,37 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                                 cause, d["count"], d["seconds"]))
                     top = snap.get("top_bubble_cause")
                     print("top bubble cause: %s" % (top or "none"))
+            elif op == "peers":
+                # per-peer network observatory (round 23, ISSUE-19):
+                # same snapshot the proxy serves on GET /peers
+                import json as _json
+                snap = node.get_peers()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("peer ledger disabled")
+                else:
+                    print("%d peer(s) tracked (capacity %d, %d "
+                          "evicted), adaptive RTO %s" % (
+                              snap.get("tracked", 0),
+                              snap.get("capacity", 0),
+                              snap.get("evicted", 0),
+                              "on" if snap.get("adaptive_rto")
+                              else "off"))
+                    print("%-28s %-8s %9s %9s %6s %6s %6s %5s" % (
+                        "peer", "status", "srtt_ms", "rto_ms", "sent",
+                        "done", "exp", "flap"))
+                    for p in snap.get("peers", []):
+                        print("%-28s %-8s %9s %9.1f %6d %6d %6d %5d"
+                              % (p["peer"][:28], p["status"] or "?",
+                                 "%.1f" % (p["srtt"] * 1e3)
+                                 if p["srtt"] is not None else "-",
+                                 p["rto"] * 1e3, p["sent"],
+                                 p["completed"], p["expired"],
+                                 p["flaps"]))
+                    fs = snap.get("fail_signal")
+                    print("worst-link fail ratio: %s" % (
+                        "%.2f" % fs if fs is not None else "unknown"))
             elif op == "bundle":
                 # post-mortem black-box bundle (round 17): same
                 # artifact the proxy serves on GET /debug/bundle

@@ -68,6 +68,7 @@ def topology_snapshot(node) -> dict:
         "reshard": {},
         "waterfall": {},
         "pipeline": {},
+        "peers": {},
         "chaos": {},
         "events": [],
     }
@@ -85,6 +86,14 @@ def topology_snapshot(node) -> dict:
         # diff shows WHETHER the device stayed busy between snapshots
         # and whose fault the gaps were
         snap["pipeline"] = node.get_pipeline()
+    except Exception:
+        pass
+    try:
+        # round-23 per-peer observatory: srtt/RTO, outcome counts and
+        # flap transitions per remote peer, so a soak diff shows WHICH
+        # link degraded between snapshots (and the wire-map assembler
+        # can rebuild the cluster's directed link graph offline)
+        snap["peers"] = node.get_peers()
     except Exception:
         pass
     try:

@@ -1,0 +1,218 @@
+"""First unit coverage for net/node.py (ISSUE-19 satellite): the
+liveness classification boundaries the round-23 per-peer ledger
+mirrors (reference node.h:79-92, node.cpp:39-46), the strict
+``time > reply_time`` incoming rule, reset/expiry bookkeeping, auth
+strikes, tid generation, and the request-side seams the ledger hangs
+off (``Request.is_expired`` honouring the per-peer ``rto``, and the
+censored-attempt counter ticked at the EXPIRED transition)."""
+
+import pytest
+
+from opendht_tpu import telemetry
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.net import MessageType
+from opendht_tpu.net.node import (
+    MAX_AUTH_ERRORS, MAX_RESPONSE_TIME, NODE_EXPIRE_TIME,
+    NODE_GOOD_TIME, Node)
+from opendht_tpu.net.request import MAX_ATTEMPT_COUNT, Request
+from opendht_tpu.sockaddr import SockAddr
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
+NOW = 1_000_000.0
+
+
+def _node(name="peer"):
+    return Node(InfoHash.get(name), SockAddr("10.0.0.9", 4009))
+
+
+def _req(node, tid=1):
+    return Request(MessageType.PING, tid, node, b"", None, None)
+
+
+# ----------------------------------------------- liveness boundaries
+def test_is_good_boundaries():
+    """is_good = replied within NODE_GOOD_TIME AND heard within
+    NODE_EXPIRE_TIME (both inclusive, node.h:79-82) AND not expired."""
+    n = _node()
+    n.time = n.reply_time = NOW
+    assert n.is_good(NOW)
+    # reply exactly at the 2 h boundary still counts (>=)
+    n.reply_time = NOW - NODE_GOOD_TIME
+    assert n.is_good(NOW)
+    n.reply_time = NOW - NODE_GOOD_TIME - 1e-3
+    assert not n.is_good(NOW)
+    # heard exactly at the 10 min boundary still counts
+    n.reply_time = NOW
+    n.time = NOW - NODE_EXPIRE_TIME
+    assert n.is_good(NOW)
+    n.time = NOW - NODE_EXPIRE_TIME - 1e-3
+    assert not n.is_good(NOW)
+    # the expired flag vetoes everything
+    n.time = n.reply_time = NOW
+    n.expired = True
+    assert not n.is_good(NOW)
+    # a never-heard node is neither good nor removable
+    fresh = _node("fresh")
+    assert not fresh.is_good(NOW)
+    assert not fresh.is_removable(NOW)
+
+
+def test_is_old_and_removable_boundaries():
+    n = _node()
+    n.time = NOW - NODE_EXPIRE_TIME
+    # strict compare: time + NODE_EXPIRE_TIME < now
+    assert not n.is_old(NOW)
+    assert n.is_old(NOW + 1e-3)
+    n.expired = True
+    assert not n.is_removable(NOW)          # expired but not old yet
+    assert n.is_removable(NOW + 1e-3)       # both
+    n.expired = False
+    assert not n.is_removable(NOW + 1e-3)   # old but not expired
+
+
+def test_is_incoming_strict_rule():
+    """time > reply_time, STRICT: a node we only heard from (never
+    answered us) is incoming; a node whose last event was our reply
+    is not."""
+    n = _node()
+    assert not n.is_incoming()              # both -inf: equal
+    n.received(NOW)                          # heard, no reply
+    assert n.is_incoming()
+    req = _req(n)
+    n.requested(req)
+    n.received(NOW + 1.0, req)               # answered: time == reply_time
+    assert not n.is_incoming()
+
+
+# -------------------------------------------- received/reset/expiry
+def test_received_updates_times_and_clears_expired():
+    n = _node()
+    n.set_expired()
+    assert n.expired
+    n.received(NOW)
+    assert n.time == NOW and n.reply_time < NOW
+    assert not n.expired
+    req = _req(n, tid=7)
+    n.requested(req)
+    assert n.get_request(7) is req
+    n.received(NOW + 2.0, req)
+    assert n.reply_time == NOW + 2.0
+    assert n.get_request(7) is None          # answered requests drop
+
+
+def test_reset_clears_expired_and_reply_time_keeps_time():
+    n = _node()
+    req = _req(n)
+    n.requested(req)
+    n.received(NOW, req)
+    n.set_expired()
+    n.reset()
+    assert not n.expired
+    assert n.reply_time == float("-inf")     # must re-earn goodness
+    assert n.time == NOW                     # but we did hear from it
+    assert not n.is_good(NOW)
+
+
+def test_set_expired_cascades_to_requests_and_sockets():
+    n = _node()
+    r1, r2 = _req(n, 1), _req(n, 2)
+    n.requested(r1)
+    n.requested(r2)
+    sid = n.open_socket(lambda node, msg: None)
+    assert n.get_socket(sid) is not None
+    n.set_expired()
+    assert n.expired
+    assert r1.expired and r2.expired
+    assert n.requests == {} and n.sockets == {}
+
+
+def test_requested_replaces_stale_same_tid():
+    n = _node()
+    old, new = _req(n, 5), _req(n, 5)
+    n.requested(old)
+    n.requested(new)
+    assert old.expired                       # the stale one is expired
+    assert n.get_request(5) is new
+
+
+def test_cancel_request_pops_and_cancels():
+    n = _node()
+    req = _req(n, 9)
+    n.requested(req)
+    n.cancel_request(req)
+    assert req.cancelled
+    assert n.get_request(9) is None
+    n.cancel_request(None)                   # no-op, no crash
+
+
+def test_auth_strikes_and_recovery():
+    n = _node()
+    for _ in range(MAX_AUTH_ERRORS):
+        n.auth_error()
+    assert not n.expired                     # at the limit: still in
+    n.auth_error()                           # one past it
+    assert n.expired
+    n.auth_success()
+    assert n.auth_errors == 0
+
+
+def test_tid_generator_skips_zero_and_wraps():
+    n = _node()
+    n._tid = 0xFFFFFFFF
+    assert n.get_new_tid() == 1              # 0 is reserved
+    assert n.get_new_tid() == 2
+
+
+# ------------------------------------------------ request-side seams
+def test_request_is_expired_honours_per_peer_rto():
+    """is_expired fires at last_try + rto INCLUSIVE; rto is the
+    ledger's adaptive per-peer timeout when enabled and stays the
+    fixed MAX_RESPONSE_TIME otherwise (ISSUE-19)."""
+    n = _node()
+    req = _req(n)
+    req.attempt_count = MAX_ATTEMPT_COUNT
+    req.last_try = NOW
+    assert req.rto == MAX_RESPONSE_TIME      # the default is the pin
+    assert not req.is_expired(NOW + MAX_RESPONSE_TIME - 1e-3)
+    assert req.is_expired(NOW + MAX_RESPONSE_TIME)
+    req.rto = 0.25                           # an adaptive fast peer
+    assert req.is_expired(NOW + 0.25)
+    req.rto = 2.5                            # a backed-off slow peer
+    assert not req.is_expired(NOW + 1.0)
+    assert req.is_expired(NOW + 2.5)
+    # attempts not used up yet: never expired, whatever the clock says
+    req.attempt_count = MAX_ATTEMPT_COUNT - 1
+    assert not req.is_expired(NOW + 100.0)
+
+
+def _attempt_timeouts_total():
+    reg = telemetry.get_registry()
+    return sum(m.value for m in
+               reg.series("dht_net_attempt_timeouts_total").values())
+
+
+def test_attempt_timeouts_counter_ticks_at_expired():
+    """ISSUE-19 satellite: every attempt of an expired request timed
+    out without reaching dht_net_rtt_seconds — the censored attempts
+    are counted so loss shows up next to RTT instead of silently
+    thinning the histogram."""
+    n = _node()
+    req = _req(n, 1)
+    req.attempt_count = 3
+    base = _attempt_timeouts_total()
+    req.set_expired()
+    assert _attempt_timeouts_total() == base + 3
+    # a request expired before any attempt (node.set_expired) still
+    # censored one solicited answer
+    req0 = _req(n, 2)
+    assert req0.attempt_count == 0
+    base = _attempt_timeouts_total()
+    req0.set_expired()
+    assert _attempt_timeouts_total() == base + 1
+    # cancellation does NOT touch the censored counter
+    req1 = _req(n, 3)
+    req1.attempt_count = 2
+    base = _attempt_timeouts_total()
+    req1.cancel()
+    assert _attempt_timeouts_total() == base

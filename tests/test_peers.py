@@ -1,0 +1,513 @@
+"""Per-peer network observatory unit tests (round 23, ISSUE-19):
+the RFC 6298 estimator math, the adaptive-RTO clamp and its
+behaviour-equivalence pin (zero samples / knob off / ledger disabled
+=> exactly the fixed MAX_RESPONSE_TIME, including an engine-level
+retransmit-schedule pin), both halves of Karn's algorithm (sampling
+rule + exponential backoff), LRU eviction parking gauges at the -1
+unknown sentinel, flap-transition mirroring of the reference's Node
+liveness rules, the fail_signal floor, the snapshot document shape,
+the wiremap assembler's skew/violation contract and the
+``dhtmon --max-peer-fail`` worst-link / unknown-never-violates gate."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from opendht_tpu import telemetry
+from opendht_tpu.infohash import InfoHash
+from opendht_tpu.net.node import MAX_RESPONSE_TIME, Node
+from opendht_tpu.peers import _FIXED_PATIENCE, PeerLedger, PeersConfig
+from opendht_tpu.sockaddr import SockAddr
+from opendht_tpu.testing import wiremap_assembler as wma
+from opendht_tpu.tools import dhtmon
+
+pytestmark = pytest.mark.quick  # sub-minute smoke tier: -m quick
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakePeer:
+    """Duck-typed net.Node: the ledger reads id/addr and the liveness
+    pair (expired / is_good)."""
+
+    def __init__(self, pid="feedc0de" + "0" * 32, addr="10.0.0.1:4000",
+                 good=True):
+        self.id = pid
+        self.addr = addr
+        self.expired = False
+        self.good = good
+
+    def is_good(self, now):
+        return self.good
+
+
+def _req(peer, attempts=1, mtype="get", nbytes=64):
+    return SimpleNamespace(node=peer, attempt_count=attempts,
+                           type=SimpleNamespace(value=mtype),
+                           msg=b"x" * nbytes)
+
+
+def _ledger(node="t", **kw):
+    clock = FakeClock()
+    reg = telemetry.MetricsRegistry()
+    led = PeerLedger(PeersConfig(**kw), node=node, clock=clock,
+                     registry=reg)
+    return led, clock, reg
+
+
+def _row(led, peer):
+    for p in led.snapshot()["peers"]:
+        if p["id"] == peer.id:
+            return p
+    return None
+
+
+# ----------------------------------------------------------- RFC 6298
+def test_rfc6298_estimator_math():
+    """First sample seeds srtt=rtt, rttvar=rtt/2; every later sample
+    applies the 7/8 / 3/4 EWMA coefficients exactly."""
+    led, _, _ = _ledger()
+    p = FakePeer()
+    led.on_request_completed(_req(p), 0.100)
+    row = _row(led, p)
+    assert row["srtt"] == pytest.approx(0.100)
+    assert row["rttvar"] == pytest.approx(0.050)
+    assert row["samples"] == 1
+    led.on_request_completed(_req(p), 0.200)
+    row = _row(led, p)
+    # rttvar <- 0.75*0.05 + 0.25*|0.1 - 0.2|; srtt <- 0.875*0.1 + 0.125*0.2
+    assert row["rttvar"] == pytest.approx(0.0625)
+    assert row["srtt"] == pytest.approx(0.1125)
+    assert row["samples"] == 2
+
+
+def test_rtt_histogram_per_peer():
+    led, _, reg = _ledger()
+    p = FakePeer()
+    led.on_request_completed(_req(p), 0.010)
+    led.on_request_completed(_req(p), 0.020)
+    series = reg.series("dht_peer_rtt_seconds")
+    assert len(series) == 1
+    (h,) = series.values()
+    assert h.count == 2
+
+
+# ------------------------------------- the behaviour-equivalence pin
+def test_rto_pin_zero_samples_knob_off_disabled():
+    """The acceptance pin: with zero RTT samples, the knob off, or the
+    ledger disabled, rto() is EXACTLY the fixed MAX_RESPONSE_TIME."""
+    p = FakePeer()
+    # adaptive on, peer never seen
+    led, _, _ = _ledger(adaptive_rto=True)
+    assert led.rto(p) == MAX_RESPONSE_TIME
+    # adaptive on, peer tracked but zero samples — even after timeouts
+    # bumped the Karn backoff (backoff must not steer no-sample peers)
+    led.on_send(p, "get", 64)
+    led.on_retransmit(_req(p, attempts=2))
+    led.on_request_expired(_req(p, attempts=3))
+    assert _row(led, p)["backoff"] == 2
+    assert led.rto(p) == MAX_RESPONSE_TIME
+    # knob off: the ledger still measures, the timer never moves
+    led, _, _ = _ledger(adaptive_rto=False)
+    led.on_request_completed(_req(p), 0.5)
+    assert _row(led, p)["srtt"] == pytest.approx(0.5)
+    assert led.rto(p) == MAX_RESPONSE_TIME
+    assert _row(led, p)["rto"] == MAX_RESPONSE_TIME
+    # master switch off: no tracking at all
+    led, _, _ = _ledger(enabled=False, adaptive_rto=True)
+    led.on_send(p, "get", 64)
+    led.on_request_completed(_req(p), 0.5)
+    assert led.rto(p) == MAX_RESPONSE_TIME
+    snap = led.snapshot()
+    assert snap["tracked"] == 0 and snap["enabled"] is False
+
+
+def test_adaptive_rto_formula_and_clamps():
+    led, _, _ = _ledger(adaptive_rto=True)
+    p = FakePeer()
+    led.on_request_completed(_req(p), 0.100)
+    # srtt + 4*rttvar = 0.1 + 4*0.05
+    assert led.rto(p) == pytest.approx(0.300)
+    # a 2 ms peer clamps up to rto_min
+    led, _, _ = _ledger(adaptive_rto=True)
+    led.on_request_completed(_req(p), 0.002)
+    assert led.rto(p) == pytest.approx(0.25)
+    # a multi-second estimate clamps to the default ceiling: the fixed
+    # path's total 3 x MAX_RESPONSE_TIME patience
+    led, _, _ = _ledger(adaptive_rto=True)
+    led.on_request_completed(_req(p), 2.0)
+    assert led.rto(p) == pytest.approx(_FIXED_PATIENCE)
+    # the strict escape-hatch clamp: rto_max = 1.0
+    led, _, _ = _ledger(adaptive_rto=True, rto_max=1.0)
+    led.on_request_completed(_req(p), 2.0)
+    assert led.rto(p) == pytest.approx(1.0)
+
+
+# ------------------------------------------------- Karn's algorithm
+def test_karn_backoff_doubles_and_resets():
+    led, _, _ = _ledger(adaptive_rto=True)
+    p = FakePeer()
+    led.on_request_completed(_req(p), 0.100)   # base RTO 0.3
+    led.on_retransmit(_req(p, attempts=2))
+    assert led.rto(p) == pytest.approx(0.600)
+    led.on_retransmit(_req(p, attempts=3))
+    assert led.rto(p) == pytest.approx(1.200)
+    # a final request expiry keeps backing off
+    led.on_request_expired(_req(p, attempts=3))
+    assert _row(led, p)["backoff"] == 3
+    assert led.rto(p) == pytest.approx(2.400)
+    # ...until the ceiling
+    led.on_retransmit(_req(p, attempts=2))
+    assert led.rto(p) == pytest.approx(_FIXED_PATIENCE)
+    # the exponent caps at 8 no matter how many timeouts pile up
+    for _ in range(20):
+        led.on_request_expired(_req(p, attempts=3))
+    assert _row(led, p)["backoff"] == 8
+    # one clean sample (un-retransmitted attempt) ends the backoff
+    # (the repeat sample also decays rttvar: 0.1 + 4*0.0375 clamps
+    # up to rto_min)
+    led.on_request_completed(_req(p, attempts=1), 0.100)
+    assert _row(led, p)["backoff"] == 0
+    assert led.rto(p) == pytest.approx(0.25)
+
+
+def test_karn_sampling_rule_and_spurious_counting():
+    """A reply after a retransmit is ambiguous: no RTT sample, and the
+    extra attempts are counted as spurious retransmits (the reply was
+    already in flight)."""
+    led, _, reg = _ledger()
+    p = FakePeer()
+    led.on_request_completed(_req(p, attempts=3), 0.100)
+    row = _row(led, p)
+    assert row["samples"] == 0 and row["srtt"] is None
+    assert row["spurious_retransmits"] == 2
+    assert row["completed"] == 1
+    (c,) = reg.series("dht_peer_spurious_retransmits_total").values()
+    assert c.value == 2
+    # a retransmitted completion must NOT reset the backoff either
+    led.on_request_expired(_req(p, attempts=3))
+    led.on_request_completed(_req(p, attempts=2), None)
+    assert _row(led, p)["backoff"] == 1
+    # a clean completion with no measurable RTT: counted, not sampled
+    led.on_request_completed(_req(p, attempts=1), None)
+    assert _row(led, p)["samples"] == 0
+    assert _row(led, p)["completed"] == 3
+
+
+# ------------------------------------------------------ LRU eviction
+def test_lru_eviction_parks_gauges_at_unknown():
+    led, _, reg = _ledger(capacity=2)
+    a = FakePeer(pid="aaaa" * 10, addr="10.0.0.1:1")
+    b = FakePeer(pid="bbbb" * 10, addr="10.0.0.2:2")
+    c = FakePeer(pid="cccc" * 10, addr="10.0.0.3:3")
+    led.on_request_completed(_req(a), 0.1)     # a has a live srtt gauge
+    led.on_send(b, "get", 10)
+    led.on_send(a, "get", 10)                  # LRU touch: a is newest
+    led.on_send(c, "get", 10)                  # evicts b, NOT a
+    snap = led.snapshot()
+    assert snap["tracked"] == 2 and snap["evicted"] == 1
+    assert {p["id"] for p in snap["peers"]} == {a.id, c.id}
+    (ev,) = reg.series("dht_peer_evicted_total").values()
+    assert ev.value == 1
+    assert reg.series("dht_peer_tracked")[next(
+        iter(reg.series("dht_peer_tracked")))].value == 2.0
+    # now evict a: its srtt gauge (0.1) must park at the -1 sentinel
+    # every per-peer reader treats as unknown
+    led.on_send(b, "get", 10)
+    g = [m for k, m in reg.series("dht_peer_srtt_seconds").items()
+         if dict(k).get("peer", "").startswith("aaaaaaaa@")]
+    assert len(g) == 1 and g[0].value == -1.0
+
+
+# ------------------------------------------------- status flaps
+def test_flap_transitions_mirror_node_liveness():
+    led, _, reg = _ledger()
+    p = FakePeer(good=True)
+    led.on_send(p, "get", 10)
+    assert _row(led, p)["status"] == "good"
+    assert _row(led, p)["flaps"] == 0
+    p.good = False
+    led.on_send(p, "get", 10)
+    row = _row(led, p)
+    assert row["status"] == "dubious" and row["flaps"] == 1
+    assert row["transitions"] == {"good->dubious": 1}
+    p.expired = True
+    led.on_received(p, "reply", 10)
+    row = _row(led, p)
+    assert row["status"] == "expired" and row["flaps"] == 2
+    assert row["transitions"]["dubious->expired"] == 1
+    (c,) = reg.series("dht_peer_flaps_total").values()
+    assert c.value == 2
+
+
+# --------------------------------------------------- fail signal
+def test_fail_signal_floor_and_worst_link():
+    led, _, _ = _ledger(min_signal_events=4)
+    p = FakePeer(pid="dddd" * 10, addr="10.0.0.4:4")
+    for _ in range(2):
+        led.on_send(p, "get", 10)
+    led.on_request_expired(_req(p, attempts=3))
+    led.on_request_expired(_req(p, attempts=3))
+    # 2/2 expired but only 2 requests: below the signal floor
+    assert led.fail_signal() is None
+    assert _row(led, p)["fail_ratio"] == pytest.approx(1.0)
+    for _ in range(2):
+        led.on_send(p, "get", 10)
+    led.on_request_completed(_req(p), 0.01)
+    led.on_request_completed(_req(p), 0.01)
+    assert led.fail_signal() == pytest.approx(0.5)
+    # the signal is the WORST qualifying link, not an average
+    q = FakePeer(pid="eeee" * 10, addr="10.0.0.5:5")
+    for _ in range(4):
+        led.on_send(q, "get", 10)
+        led.on_request_completed(_req(q), 0.01)
+    assert led.fail_signal() == pytest.approx(0.5)
+    # the gauge parks at -1 below the floor (dhtmon's unknown contract)
+    led2, _, reg2 = _ledger(min_signal_events=8)
+    led2.on_send(p, "get", 10)
+    led2.on_request_expired(_req(p, attempts=3))
+    (g,) = reg2.series("dht_peer_fail_ratio").values()
+    assert g.value == -1.0
+
+
+# ---------------------------------------------------- doc surfaces
+def test_snapshot_shape_and_recency_order():
+    led, clock, _ = _ledger()
+    a = FakePeer(pid="aaaa" * 10, addr="10.0.0.1:1")
+    b = FakePeer(pid="bbbb" * 10, addr="10.0.0.2:2")
+    led.on_send(a, "get", 100)
+    clock.t += 5.0
+    led.on_received(b, "reply", 200)
+    snap = led.snapshot()
+    for key in ("enabled", "node", "time", "adaptive_rto", "rto_min",
+                "rto_max", "capacity", "tracked", "evicted",
+                "fail_signal", "peers"):
+        assert key in snap, key
+    assert snap["node"] == "t" and snap["time"] == clock.t
+    # most recently touched first (the REPL / scanner print order)
+    assert [p["id"] for p in snap["peers"]] == [b.id, a.id]
+    row = snap["peers"][1]
+    for key in ("id", "addr", "peer", "srtt", "rttvar", "rto",
+                "samples", "backoff", "sent", "completed", "expired",
+                "cancelled", "attempt_timeouts", "spurious_retransmits",
+                "fail_ratio", "bytes_in", "bytes_out", "msgs_in",
+                "status", "flaps", "transitions", "first_seen",
+                "last_seen"):
+        assert key in row, key
+    assert row["bytes_out"] == {"get": 100}
+    assert snap["peers"][0]["bytes_in"] == {"reply": 200}
+    assert snap["peers"][0]["msgs_in"] == 1
+
+
+def test_bytes_by_type_and_cancelled():
+    led, _, reg = _ledger()
+    p = FakePeer()
+    led.on_send(p, "get", 100)
+    led.on_send(p, "put", 300)
+    led.on_received(p, "reply", 200)
+    led.on_received(p, "reply", 0)      # reassembled: size unknown
+    led.on_request_cancelled(_req(p))
+    row = _row(led, p)
+    assert row["bytes_out"] == {"get": 100, "put": 300}
+    assert row["bytes_in"] == {"reply": 200}
+    assert row["msgs_in"] == 2 and row["cancelled"] == 1
+    series = reg.series("dht_peer_bytes_total")
+    by_dir = {}
+    for key, c in series.items():
+        labels = dict(key)
+        assert "direction" in labels and "type" in labels
+        by_dir.setdefault(labels["direction"], 0)
+        by_dir[labels["direction"]] += c.value
+    assert by_dir == {"out": 400, "in": 200}
+
+
+def test_runner_get_peers_degrades_before_run():
+    """The GET /peers spine degrades to {"enabled": False} on a
+    runner that is not running — and the wiremap assembler treats
+    that as a missing ledger, not a crash."""
+    from opendht_tpu.runtime.runner import DhtRunner
+    r = DhtRunner()
+    assert r.get_peers() == {"enabled": False}
+    wm = wma.assemble_wiremap([r])
+    assert wm["nodes"] == [] and wm["edges"] == []
+    assert wm["violations"] == ["source 0: no per-peer ledger"]
+
+
+# ------------------------------------- engine-level equivalence pin
+def _blackhole_schedule(adaptive):
+    """Send one ping into a black hole and return the clock times of
+    every (re)transmission under fine-grained stepping."""
+    from test_net_engine import Net
+    net = Net()
+    a = net.make_engine("alice", 1)
+    sent_at = []
+    a._send_fn = lambda data, dst: sent_at.append(round(net.clock.t, 6)) or 0
+    if adaptive is not None:
+        a.peers = PeerLedger(PeersConfig(adaptive_rto=adaptive),
+                             node="alice", clock=net.clock,
+                             registry=telemetry.MetricsRegistry())
+    node = Node(InfoHash.get("bob"), SockAddr("10.0.0.9", 1234))
+    a.send_ping(node)
+    for _ in range(40):
+        net.advance(0.25)
+    return sent_at
+
+
+def test_engine_schedule_identical_with_zero_samples():
+    """The acceptance pin at the engine seam: with the ledger attached
+    and adaptive_rto ON but zero RTT samples, the retransmit schedule
+    is step-for-step identical to the no-ledger engine."""
+    bare = _blackhole_schedule(None)
+    fixed = _blackhole_schedule(False)
+    adaptive = _blackhole_schedule(True)
+    assert len(bare) == 3               # MAX_ATTEMPT_COUNT
+    assert fixed == bare
+    assert adaptive == bare
+
+
+def test_engine_adaptive_rto_consulted_after_sample():
+    """With a fast RTT sample banked, the engine retransmits off the
+    per-peer RTO (rto_min-clamped 0.25 s) instead of waiting the fixed
+    1.0 s — the knob actually steers the scheduler."""
+    from test_net_engine import Net
+    net = Net()
+    a = net.make_engine("alice", 1)
+    sent_at = []
+    a._send_fn = lambda data, dst: sent_at.append(round(net.clock.t, 6)) or 0
+    led = PeerLedger(PeersConfig(adaptive_rto=True), node="alice",
+                     clock=net.clock, registry=telemetry.MetricsRegistry())
+    a.peers = led
+    node = Node(InfoHash.get("bob"), SockAddr("10.0.0.9", 1234))
+    led.on_request_completed(_req(node), 0.002)    # srtt 2 ms -> RTO 0.25
+    req = a.send_ping(node)
+    assert req.rto == pytest.approx(0.25)
+    for _ in range(8):
+        net.advance(0.25)
+    assert len(sent_at) >= 2, sent_at
+    assert sent_at[1] - sent_at[0] <= 0.5 + 1e-9, sent_at
+
+
+# ------------------------------------------------ wiremap assembler
+def _peers_doc(node, peers, t=100.0, **extra):
+    doc = {"enabled": True, "node": node, "time": t, "tracked":
+           len(peers), "evicted": 0, "adaptive_rto": False,
+           "peers": peers}
+    doc.update(extra)
+    return doc
+
+
+def _edge_doc(pid, first, last, fail=None, **extra):
+    d = {"id": pid, "addr": "10.0.0.9:9", "peer": pid[:8] + "@x",
+         "first_seen": first, "last_seen": last, "fail_ratio": fail}
+    d.update(extra)
+    return d
+
+
+def test_wiremap_from_ledgers_edges_and_attribution():
+    lA, _, _ = _ledger(node="A")
+    lB, _, _ = _ledger(node="B")
+    pb = FakePeer(pid="B", addr="10.0.0.2:2")
+    pc = FakePeer(pid="C", addr="10.0.0.3:3")     # outside the map
+    pa = FakePeer(pid="A", addr="10.0.0.1:1")
+    lA.on_send(pb, "get", 10)
+    lA.on_request_expired(_req(pb, attempts=3))
+    lA.on_request_completed(_req(pb), 0.01)
+    lA.on_send(pc, "get", 10)
+    lB.on_send(pa, "get", 10)
+    lB.on_request_completed(_req(pa), 0.01)
+    wm = wma.assemble_wiremap([lA, lB])
+    assert wm["violations"] == []
+    assert {n["id"] for n in wm["nodes"]} == {"A", "B"}
+    assert len(wm["edges"]) == 3
+    ab = wma.find_edge(wm, "A", "B")
+    assert ab is not None and ab["known"] is True
+    assert ab["fail_ratio"] == pytest.approx(0.5)
+    ac = wma.find_edge(wm, "A", "C")
+    assert ac is not None and ac["known"] is False
+    assert wma.find_edge(wm, "B", "C") is None
+    # rank excludes unknown-metric edges; worst is the lossy one
+    ranked = wma.rank_edges(wm, "fail_ratio")
+    assert [e["dst"] for e in ranked] == ["B", "A"]
+    worst = wma.worst_edge(wm, "fail_ratio")
+    assert worst["src"] == "A" and worst["dst"] == "B"
+    # every edge is unknown on a metric nobody has -> worst is None
+    assert wma.worst_edge(wm, "no_such_metric") is None
+
+
+def test_wiremap_skew_adjustment_and_violations():
+    # node A runs 10 s ahead of the scraper's wall clock
+    docA = _peers_doc("A", [_edge_doc("B", 50.0, 99.0, fail=0.5)],
+                      t=100.0, scraped_at=90.0, endpoint="a:1")
+    wm = wma.assemble_wiremap([docA])
+    assert wm["violations"] == []
+    assert wm["skew"]["A"] == pytest.approx(10.0)
+    (e,) = wm["edges"]
+    assert e["last_seen_adj"] == pytest.approx(89.0)
+    assert e["first_seen_adj"] == pytest.approx(40.0)
+    # a peer row stamped after its own snapshot: REPORTED, never
+    # dropped (a post-mortem tool must degrade, not lie)
+    docB = _peers_doc("B", [_edge_doc("A", 50.0, 100.2)], t=100.0)
+    wm = wma.assemble_wiremap([docB])
+    assert len(wm["edges"]) == 1
+    assert any("after its own snapshot" in v for v in wm["violations"])
+    # first_seen > last_seen
+    docC = _peers_doc("C", [_edge_doc("A", 60.0, 50.0)], t=100.0)
+    wm = wma.assemble_wiremap([docC])
+    assert any("first_seen" in v for v in wm["violations"])
+    # a disabled/absent ledger is a reported violation, with the
+    # healthy sources still assembled
+    wm = wma.assemble_wiremap([{"enabled": False}, docA])
+    assert wm["violations"] == ["source 0: no per-peer ledger"]
+    assert len(wm["nodes"]) == 1
+
+
+# ------------------------------------------- dhtmon --max-peer-fail
+def _fake_scraper(series_by_ep):
+    def scrape(ep, timeout=10.0):
+        return {"endpoint": ep, "ready": True, "verdict": "ok",
+                "health": {}, "series": dict(series_by_ep[ep])}
+    return scrape
+
+
+def test_dhtmon_max_peer_fail_worst_link_gate(monkeypatch):
+    series = {
+        "n1": {'dht_peer_fail_ratio{node="n1",peer="p1@x"}': 0.4,
+               'dht_peer_fail_ratio{node="n1",peer="p2@x"}': -1.0},
+        "n2": {'dht_peer_fail_ratio{node="n2",peer="p3@x"}': 0.1},
+    }
+    monkeypatch.setattr(dhtmon.hm, "scrape_node", _fake_scraper(series))
+    eps = ["n1", "n2"]
+    violations, doc = dhtmon.run_checks(eps, max_peer_fail=0.5)
+    assert violations == []
+    assert doc["peer_fail"]["max"] == pytest.approx(0.4)
+    violations, doc = dhtmon.run_checks(eps, max_peer_fail=0.3)
+    assert len(violations) == 1 and "n1" in violations[0]
+    assert "peer fail ratio" in violations[0]
+    # the gate is per-link worst, not an average: 0.25 would pass a
+    # mean but the single 0.4 link must trip it
+    violations, _doc = dhtmon.run_checks(eps, max_peer_fail=0.25)
+    assert len(violations) == 1
+    # the gate only exists when asked for
+    _violations, doc = dhtmon.run_checks(eps)
+    assert "peer_fail" not in doc
+
+
+def test_dhtmon_max_peer_fail_unknown_never_violates(monkeypatch):
+    # every gauge parked/absent: ledger off, evicted, or below the
+    # signal floor — unknown must never violate, even at threshold 0
+    series = {
+        "n1": {'dht_peer_fail_ratio{node="n1",peer="p1@x"}': -1.0},
+        "n2": {},
+    }
+    monkeypatch.setattr(dhtmon.hm, "scrape_node", _fake_scraper(series))
+    violations, doc = dhtmon.run_checks(["n1", "n2"], max_peer_fail=0.0)
+    assert violations == []
+    assert doc["peer_fail"]["max"] is None
+    assert all(p["peer_fail"] is None
+               for p in doc["peer_fail"]["per_node"])
