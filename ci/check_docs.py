@@ -311,7 +311,7 @@ def check_tp_wire(failures):
 _OVERHEAD_CAPS = ("health_overhead", "keyspace_overhead",
                   "cache_overhead", "history_overhead",
                   "waterfall_overhead", "pipeutil_overhead",
-                  "peers_overhead")
+                  "peers_overhead", "listener_overhead")
 
 
 def check_overhead_captures(failures):
@@ -669,6 +669,71 @@ def check_peer_ledger(failures):
                     f"outputs bit-identical with the ledger on)")
 
 
+def check_listener_match(failures):
+    """Round-24 rule, BOTH directions: the committed listener
+    amortization artifact (``captures/listener_match.json``) must
+    itself satisfy the ISSUE-20 acceptance — the batched per-listener
+    delivery slope below the host per-put dispatch slope, measured out
+    to L=100k listeners — and README *and* PARITY must each carry a
+    ``<!-- capture:listener_match -->``-tagged paragraph stating the
+    result-equivalence claim (batched deliveries **result-equivalent**
+    to the synchronous path) next to a quoted slope ratio that matches
+    the artifact (±15%); a tagged claim without the artifact (or vice
+    versa) fails."""
+    cap_path = os.path.join(ROOT, "captures", "listener_match.json")
+    cap = None
+    if os.path.exists(cap_path):
+        with open(cap_path) as f:
+            cap = json.load(f)
+        host = float(cap.get("host_slope_ns_per_listener", 0.0))
+        bat = float(cap.get("batched_slope_ns_per_listener", 0.0))
+        if not bat < host:
+            failures.append(
+                "captures/listener_match.json: batched slope %r "
+                "ns/listener not below the host slope %r — the "
+                "amortization claim fails in the artifact itself"
+                % (bat, host))
+        if max((r.get("L", 0) for r in cap.get("rows", [])),
+               default=0) < 100_000:
+            failures.append(
+                "captures/listener_match.json: rows stop short of the "
+                "L=100000 acceptance point")
+    tag = "<!-- capture:listener_match -->"
+    for name in ("README.md", "PARITY.md"):
+        path = os.path.join(ROOT, name)
+        if not os.path.exists(path):
+            continue
+        lines = open(path).read().splitlines()
+        tagged = [i for i, ln in enumerate(lines) if tag in ln]
+        if cap is None:
+            if tagged:
+                failures.append(f"{name}: '{tag}' claim with no "
+                                f"captures/listener_match.json artifact")
+            continue
+        if not tagged:
+            failures.append(f"{name}: no '{tag}'-tagged paragraph "
+                            f"quoting the listener amortization "
+                            f"measurement")
+            continue
+        ratio = float(cap.get("slope_ratio", 0.0))
+        for li in tagged:
+            para = _para_at(lines, li)
+            if "result-equivalent" not in para:
+                failures.append(
+                    f"{name}: [capture:listener_match] paragraph does "
+                    f"not state the result-equivalence claim (batched "
+                    f"deliveries result-equivalent to the synchronous "
+                    f"path)")
+            quoted = [float(q) for q in
+                      re.findall(r"(\d+(?:\.\d+)?)[×x]\b", para)]
+            if not any(0.85 * ratio <= q <= 1.15 * ratio
+                       for q in quoted):
+                failures.append(
+                    f"{name}: [capture:listener_match] paragraph "
+                    f"quotes no slope ratio matching the artifact's "
+                    f"{ratio:g}x (±15%): {quoted!r}")
+
+
 #: the observability index (ISSUE-10 satellite): every serving surface
 #: and the reference counterpart(s) it maps to.  BOTH directions: each
 #: surface must appear as a row of the tagged table in README AND
@@ -677,7 +742,8 @@ def check_peer_ledger(failures):
 OBS_SURFACES = ("GET /stats", "GET /trace", "GET /healthz",
                 "GET /keyspace", "GET /cache", "GET /history",
                 "GET /debug/bundle", "GET /profile", "GET /pipeline",
-                "GET /peers", "kernel ledger", "dhtscanner --json")
+                "GET /peers", "GET /listeners", "kernel ledger",
+                "dhtscanner --json")
 OBS_REFERENCES = ("getNodesStats", "dumpTables", "STATS /",
                   "DhtRunner::loop_")
 
@@ -805,6 +871,7 @@ def main() -> int:
     check_reshard_balance(failures)
     check_pipeline_util(failures)
     check_peer_ledger(failures)
+    check_listener_match(failures)
     check_observability_index(failures)
     check_trajectory(failures)
     if failures:

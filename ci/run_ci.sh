@@ -560,6 +560,45 @@ spec.loader.exec_module(m)
 rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
 assert rc == 0, "per-peer ledger overhead smoke failed"
 PY
+# wave-scale listen/push smoke (round 24): boot a 3-node real-UDP
+# cluster + proxy with >= 512 live listeners across runner ops and
+# proxy SUBSCRIBE/LISTEN registrations, flood a Zipf put mix, and pin
+# the batched listener match result-equivalent to the synchronous
+# listen_batching="off" arm on EVERY delivery surface (runner
+# callbacks with all of a key's listeners agreeing, the proxy LISTEN
+# stream, SUBSCRIBE push dispatches); dht_listener_* occupancy/
+# latency series must advance on GET /stats and dhtmon
+# --max-listener-lag must read 0 healthy and flip to 1 under an
+# injected drain stall.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")   # keep off the tunnel backend
+from opendht_tpu.testing.listener_smoke import main
+rc = main()
+assert rc == 0, "listener smoke failed"
+PY
+# listener amortization + on-cost smoke (round 24): the batched
+# per-listener delivery slope must sit below the host per-put dispatch
+# slope, and with the table ACTIVE at full capacity plus a worst-case
+# all-miss flush per trip the 8192-wave search round must stay inside
+# a generous 5% band vs the table-free run (the committed
+# captures/listener_match.json + captures/listener_overhead.json
+# document the tight numbers against the slope-ratio and <1%
+# acceptances, enforced against the README quotes by check_docs
+# above), wave outputs bit-identical in both modes.
+python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib.util, pathlib, sys
+sys.path.insert(0, str(pathlib.Path("benchmarks")))
+spec = importlib.util.spec_from_file_location(
+    "exp_listener_r24", pathlib.Path("benchmarks/exp_listener_r24.py"))
+m = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(m)
+rc = m.main(["--smoke", "-N", "16384", "-W", "1024", "--reps", "7"])
+assert rc == 0, "listener amortization smoke failed"
+PY
+
 # maintenance smoke (round 10): boot a 3-node real-UDP cluster, pin the
 # fused maintenance sweep bit-identical to the host stale set on the
 # LIVE routing table, force a bucket refresh + a due republish, and

@@ -539,6 +539,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
                  waterfall: Optional[dict] = None,
                  pipeline: Optional[dict] = None,
                  peers: Optional[dict] = None,
+                 listeners: Optional[dict] = None,
                  tracer: Optional[tracing.Tracer] = None,
                  flight_limit: int = 400) -> dict:
     """Assemble one post-mortem black-box bundle (↔ the reference's
@@ -563,6 +564,7 @@ def build_bundle(*, reason: str = "on_demand", node_id: str = "",
         "waterfall": waterfall or {},
         "pipeline": pipeline or {},
         "peers": peers or {},
+        "listeners": listeners or {},
         "history": {"enabled": False, "frames": []},
         "flight_recorder": {"spans": [], "events": []},
         "kernels": {},

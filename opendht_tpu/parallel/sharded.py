@@ -704,6 +704,55 @@ def sharded_cache_probe(mesh: Mesh, cache_ids, valid, targets):
 
 
 @functools.lru_cache(maxsize=8)
+def _build_sharded_listener_match(mesh: Mesh, capacity: int):
+    def local(table_ids, valid, stored):
+        # each shard XOR-compares ITS slice of the wave's stored-put
+        # keys against the replicated [L, 5] listener table — the
+        # ops/listener_match.py compare, fully data-parallel
+        # (membership is per-stored-key: no collective; outputs stay
+        # t-split and the caller gathers)
+        s = stored.astype(_U32)
+        t = table_ids.astype(_U32)
+        eq = jnp.all(s[:, None, :] == t[None, :, :], axis=-1) \
+            & valid[None, :]
+        hit = jnp.any(eq, axis=1)
+        slot = jnp.where(hit, jnp.argmax(eq, axis=1).astype(jnp.int32),
+                         jnp.int32(-1))
+        return hit, slot
+
+    fn = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P("t", None)),
+        out_specs=(P("t"), P("t")),
+        **_SM_KW,
+    )
+    return jax.jit(fn)
+
+
+def sharded_listener_match(mesh: Mesh, table_ids, valid, stored):
+    """tp twin of :func:`opendht_tpu.ops.listener_match.listener_match`
+    (ISSUE-20): the wave's stored-put keys ROW-SPLIT over the ``t``
+    axis against the replicated listener table, each shard answering
+    its slice locally — zero collectives (membership is per-key), so
+    the twin costs exactly the single-device compare divided by t.
+    Ragged widths pad (pad rows' answers are sliced off host-side), so
+    any S works.
+
+    Returns host ``(hit [S] bool, slot [S] int32)``, BIT-IDENTICAL to
+    the single-device match over the same keys (pinned in
+    tests/test_listener.py at t∈{2,4})."""
+    s_np = np.asarray(stored, np.uint32).reshape(-1, N_LIMBS)
+    n_t = mesh.shape["t"]
+    padded, n = pad_to_multiple(s_np, n_t)
+    fn = _build_sharded_listener_match(mesh, int(table_ids.shape[0]))
+    ops = shard_put(mesh, {"probe_ids": padded}, TABLE_AXIS_RULES)
+    hit, slot = fn(jnp.asarray(table_ids, _U32),
+                   jnp.asarray(np.asarray(valid, bool)),
+                   ops["probe_ids"])
+    return np.asarray(hit)[:n], np.asarray(slot)[:n]
+
+
+@functools.lru_cache(maxsize=8)
 def _dp_lut_builder(mesh: Mesh, bits: int):
     """Build the dp engine's prefix LUT FROM THE PLACED (replicated)
     table, with the output pinned replicated by

@@ -249,6 +249,28 @@ def _spec_cache_probe():
             {"Q": _CANON["INGEST_Q"], "C": CACHE_CAPACITY})
 
 
+def _spec_listener_match():
+    """The listener-table membership match (round 24,
+    ops/listener_match.py): one batched XOR-compare of the ingest fill
+    target S=64 stored-put keys against the default-capacity [1024, 5]
+    listener id table — the launch ``runtime/dht.py
+    flush_listener_wave`` runs once per ingest wave to drive coalesced
+    listen/push delivery, budgeted from day one so the delivery path's
+    only device work can't silently fatten (the ISSUE-20 cost-gate
+    requirement)."""
+    import jax
+    import jax.numpy as jnp
+    from .ops.listener_match import LISTENER_CAPACITY, listener_match
+    table_ids = _queries(LISTENER_CAPACITY, seed=29)
+    valid = jnp.ones((LISTENER_CAPACITY,), bool)
+    stored = _queries(_CANON["INGEST_Q"], seed=30)
+
+    def fn(table_ids, valid, stored):
+        return listener_match(table_ids, valid, stored)
+    return (jax.jit(fn), (table_ids, valid, stored), {},
+            {"S": _CANON["INGEST_Q"], "L": LISTENER_CAPACITY})
+
+
 def _spec_swarm_step():
     """The chaos swarm stepper's one-launch-per-tick device program
     (round 18, ops/swarm.py): churn draws + partition-aware analytic
@@ -502,6 +524,7 @@ KERNEL_SPECS = {
     "wave_builder_lookup": (_spec_wave_builder, "dht_ingest_wave_seconds"),
     "sketch_update": (_spec_sketch_update, None),
     "cache_probe": (_spec_cache_probe, None),
+    "listener_match": (_spec_listener_match, "dht_listener_match_seconds"),
     "swarm_step": (_spec_swarm_step, None),
     "expanded_topk": (_spec_expanded_topk, None),
     "fused_gather_planar": (_spec_fused_gather, None),

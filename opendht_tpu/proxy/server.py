@@ -519,6 +519,16 @@ def _make_handler(server: DhtProxyServer):
                 # get_pipeline already degrades to {"enabled": False}
                 self._send_json(runner.get_pipeline())
                 return
+            if parts == ["listeners"]:
+                # GET /listeners → the wave-scale listener-table
+                # snapshot (round 24): occupancy/tombstones/overflow,
+                # buffered puts, match/delivery counters and the
+                # windowed delivery-lag p95.  "listeners" is not a
+                # valid hash, so — like /stats — the route cannot
+                # shadow a key fetch.  get_listeners already degrades
+                # to {"enabled": False} on a node without the table.
+                self._send_json(runner.get_listeners())
+                return
             if parts == ["peers"]:
                 # GET /peers → the per-peer network observatory
                 # (round 23, ISSUE-19): per-peer srtt/rttvar/RTO,
@@ -631,8 +641,12 @@ def _make_handler(server: DhtProxyServer):
             updates: "queue.Queue" = queue.Queue()
 
             def cb(values, expired):
-                for v in values:
-                    updates.put((v, expired))
+                # round 24 (ISSUE-20): the batched listener path
+                # delivers a wave's values as ONE callback — enqueue
+                # the batch as a unit so the stream writer wakes once
+                # per wave per stream (wire format unchanged: still
+                # one JSON line per value, in delivery order)
+                updates.put((list(values), expired))
                 return True
 
             token_fut = runner.listen(key, cb)
@@ -659,15 +673,18 @@ def _make_handler(server: DhtProxyServer):
                 alive = True
                 while alive:
                     try:
-                        v, expired = updates.get(timeout=1.0)
+                        batch, expired = updates.get(timeout=1.0)
                     except queue.Empty:
                         # heartbeat so dead peers are detected
                         alive = self._write_line({"t": int(time.time())})
                         continue
-                    obj = value_to_json(v)
-                    if expired:            # expired marker (:741-748)
-                        obj["expired"] = True
-                    alive = self._write_line(obj)
+                    for v in batch:
+                        obj = value_to_json(v)
+                        if expired:        # expired marker (:741-748)
+                            obj["expired"] = True
+                        alive = self._write_line(obj)
+                        if not alive:
+                            break
             finally:
                 with server._lock:
                     server.stats.listen_count -= 1
@@ -819,7 +836,10 @@ def _make_handler(server: DhtProxyServer):
 
             def cb(values, expired):
                 # reference data shape :446-453; ids/expired ride along
-                # for the injected-callback embedders
+                # for the injected-callback embedders.  One _notify_push
+                # per callback: with the round-24 batched listener path
+                # a whole wave's values arrive as ONE callback, so this
+                # is one push dispatch per wave per subscription
                 server._notify_push(
                     rec,
                     {"key": key.hex(), "to": client_id,

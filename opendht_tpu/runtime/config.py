@@ -19,6 +19,7 @@ from ..waterfall import WaterfallConfig  # noqa: F401  (same knob-surface rule)
 from ..reshard import ReshardConfig  # noqa: F401  (same knob-surface rule)
 from ..pipeline_observatory import PipelineObservatoryConfig  # noqa: F401,E501  (same knob-surface rule)
 from ..peers import PeersConfig  # noqa: F401  (same knob-surface rule)
+from ..listeners import ListenerTableConfig  # noqa: F401  (same knob-surface rule)
 from ..infohash import InfoHash
 
 #: total value-store budget per node (callbacks.h:117)
@@ -241,6 +242,28 @@ class Config:
     #: builds (the ledger only observes; wire bytes are pinned
     #: bit-identical either way in benchmarks/exp_peers_r23.py).
     peers: PeersConfig = field(default_factory=PeersConfig)
+
+    # --- wave-scale listen/push (round 24, opendht_tpu/listeners.py) --
+    #: "on" defers each stored put's listener notification into a
+    #: bounded buffer answered by ONE batched XOR-equality launch per
+    #: ingest wave (``ops/listener_match.py``) and dispatches one
+    #: coalesced callback/``tell_listener``/proxy push per wave per
+    #: listener; "off" is the escape hatch — the exact synchronous
+    #: per-put probe path, pinned result-equivalent (same values, same
+    #: per-listener order) in tests/test_listener.py and
+    #: testing/listener_smoke.py.
+    listen_batching: str = "on"
+    #: the device-resident listener table behind the launch: bounded
+    #: ``[L, 5]`` key-id slots (tombstoned/compacted on cancel/expiry,
+    #: host overflow past capacity), ``entry_ttl`` re-check sweep,
+    #: ``flush_deadline`` so idle nodes still deliver promptly.
+    #: Surfaces: ``dht_listener_*`` series on ``get_metrics()``/
+    #: ``GET /stats``/the history ring, proxy ``GET /listeners``, the
+    #: ``listeners`` REPL cmd, the scanner section and ``dhtmon
+    #: --max-listener-lag``.  Device failure goes dark to the
+    #: synchronous path (a delivery can be late, never lost).
+    listeners: ListenerTableConfig = field(
+        default_factory=ListenerTableConfig)
 
 
 @dataclass

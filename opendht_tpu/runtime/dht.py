@@ -273,6 +273,26 @@ class Dht:
             clock=self.scheduler.time)
         self.engine.peers = self.peers if self.peers.enabled else None
 
+        # wave-scale listen/push (round 24, ISSUE-20): a bounded
+        # device table of the keys that currently have listeners —
+        # every stored put buffers here instead of probing listener
+        # dicts synchronously, and the next ingest wave (or the flush
+        # deadline) answers membership for the whole buffer in ONE
+        # batched XOR-equality launch (ops/listener_match.py), after
+        # which flush_listener_wave dispatches one coalesced callback/
+        # tell_listener per wave per listener.  listen_batching="off"
+        # is the escape hatch (the exact synchronous per-put path);
+        # device failure goes dark to the same path (listeners.py;
+        # config.listeners knobs).
+        from ..listeners import ListenerTable
+        self.listener_table = ListenerTable(
+            getattr(config, "listeners", None), node=str(self.myid),
+            batching=getattr(config, "listen_batching", "on"),
+            live_count=self._listener_live_count,
+            clock=self.scheduler.time,
+            request_flush=self._arm_listener_flush)
+        self._listener_flush_job = None
+
         # per-op latency waterfall (round 19, ISSUE-15): the always-on
         # stage profiler every serving layer feeds (wave builder,
         # search envelope, net engine/request) — process-global like
@@ -1540,6 +1560,7 @@ class Dht:
             st.listener_token += 1
             token_local = st.listener_token
             st.local_listeners[token_local] = LocalListener(q, filt, gcb)
+            self._listener_sync(key, st)
 
         token4 = self._listen_to(key, _socket.AF_INET, gcb, filt, q)
         token6 = self._listen_to(key, _socket.AF_INET6, gcb, filt, q)
@@ -1567,6 +1588,7 @@ class Dht:
         st = self.store.get(key)
         if st is not None and token_local:
             st.local_listeners.pop(token_local, None)
+            self._listener_sync(key, st)
         for af, t in ((_socket.AF_INET, token4), (_socket.AF_INET6, token6)):
             sr = self._searches_of(af).get(key)
             if sr is not None and t:
@@ -1656,7 +1678,21 @@ class Dht:
     def _storage_changed(self, key: InfoHash, st: Storage, value: Value,
                          new_value: bool) -> None:
         """Notify local + remote listeners of a new value
-        (↔ Dht::storageChanged, src/dht.cpp:1149-1191)."""
+        (↔ Dht::storageChanged, src/dht.cpp:1149-1191).
+
+        Round 24 (ISSUE-20): with ``listen_batching="on"`` the put is
+        BUFFERED on the listener table instead — the next ingest
+        wave's single ``listener_match`` launch answers which buffered
+        keys have listeners, and :meth:`flush_listener_wave`
+        dispatches one coalesced callback/``tell_listener`` per wave
+        per listener (same values, same per-listener order as the
+        synchronous body below — pinned in tests/test_listener.py).
+        This also batches the request-handler re-storage loops
+        (``_on_announce``'s per-value ``storage_store``): a
+        listen-triggered store now rides the wave cadence instead of
+        probing listener dicts inside the handler."""
+        if self.listener_table.note_stored(bytes(key), value, new_value):
+            return
         if new_value:
             cbs = []
             for l in st.local_listeners.values():
@@ -1672,6 +1708,95 @@ class Dht:
                 ntoken = self._make_token(node.addr, False)
                 self.engine.tell_listener(node, sid, key, 0, ntoken,
                                           [], [], [value], l.query)
+
+    # ------------------------------------------------ wave-scale listen/push
+    def _listener_live_count(self, kb: bytes) -> int:
+        """The listener table's TTL-sweep re-count: how many live
+        listeners (local + remote) a key has RIGHT NOW — the sweep
+        refreshes rows that still have some and tombstones the rest
+        (remote listeners expire silently in ``Storage.expire``; no
+        cancel ever reaches :meth:`_listener_sync` for them)."""
+        st = self.store.get(InfoHash(kb))
+        if st is None:
+            return 0
+        return (len(st.local_listeners)
+                + sum(len(m) for m in st.listeners.values()))
+
+    def _listener_sync(self, key: InfoHash, st: Optional[Storage]) -> None:
+        """Re-sync one key's row on the listener table after any
+        listener-set mutation (listen/cancel/remote add/expiry sweep)
+        — the table tracks exactly the keys with ≥1 listener, so the
+        batched match and the synchronous probe answer identically."""
+        lt = self.listener_table
+        if not lt.enabled:
+            return
+        n = 0
+        if st is not None:
+            n = (len(st.local_listeners)
+                 + sum(len(m) for m in st.listeners.values()))
+        lt.sync_key(bytes(key), n)
+
+    def _arm_listener_flush(self, delay: float) -> None:
+        """The table's ``request_flush`` callback: guarantee a
+        :meth:`flush_listener_wave` within ``delay`` seconds (idle
+        nodes deliver on the deadline; busy nodes usually flush
+        earlier, piggybacked on the next ingest wave fire)."""
+        t = self.scheduler.time() + max(0.0, delay)
+        job = self._listener_flush_job
+        if job is not None and not job.cancelled:
+            if job.time is not None and t < job.time:
+                self._listener_flush_job = self.scheduler.edit(job, t)
+        else:
+            self._listener_flush_job = self.scheduler.add(
+                t, self.flush_listener_wave)
+
+    def flush_listener_wave(self) -> None:
+        """Deliver every buffered stored put whose key has listeners:
+        ONE ``listener_match`` launch over the buffer (the table's
+        :meth:`~opendht_tpu.listeners.ListenerTable.flush`), then one
+        coalesced dispatch per listener — local callbacks get the
+        key's new values as a single batch, each remote ``(node,
+        sid)`` socket gets a single ``tell_listener`` with the full
+        filtered value list (↔ the per-value loop in the synchronous
+        ``_storage_changed`` body; order within a key is arrival
+        order, so per-listener ordering is preserved).  Runs as a
+        scheduler job and from the wave builder's fire."""
+        self._listener_flush_job = None
+        lt = self.listener_table
+        if not lt.pending():
+            return
+        dispatches = values_n = 0
+        for kb, items in lt.flush():
+            key = InfoHash(kb)
+            st = self.store.get(key)
+            if st is None:
+                continue
+            new_vals = [v for v, nv in items if nv]
+            all_vals = [v for v, _nv in items]
+            if new_vals:
+                cbs = []
+                for l in st.local_listeners.values():
+                    vs = ([v for v in new_vals if l.filter(v)]
+                          if l.filter is not None else list(new_vals))
+                    if vs:
+                        cbs.append((l.get_cb, vs))
+                for cb, vs in cbs:
+                    cb(vs, False)
+                    dispatches += 1
+                    values_n += len(vs)
+            for node, node_listeners in list(st.listeners.items()):
+                for sid, l in node_listeners.items():
+                    f = l.query.where.get_filter()
+                    vs = ([v for v in all_vals if f(v)]
+                          if f is not None else list(all_vals))
+                    if not vs:
+                        continue
+                    ntoken = self._make_token(node.addr, False)
+                    self.engine.tell_listener(node, sid, key, 0, ntoken,
+                                              [], [], vs, l.query)
+                    dispatches += 1
+                    values_n += len(vs)
+        lt.note_delivered(dispatches, values_n)
 
     def _storage_add_listener(self, key: InfoHash, node: Node,
                               socket_id: int, query: Query) -> None:
@@ -1694,8 +1819,10 @@ class Dht:
                     self._make_token(node.addr, False),
                     closest4, closest6, vals, query)
             node_listeners[socket_id] = Listener(now, query, socket_id)
+            self._listener_sync(key, st)
         else:
             l.refresh(now, query)
+            self._listener_sync(key, st)
 
     def _expire_storage(self, key: InfoHash) -> None:
         st = self.store.get(key)
@@ -1707,6 +1834,9 @@ class Dht:
         size_diff, expired = st.expire(key, self.scheduler.time())
         self.total_store_size += size_diff
         self.total_values -= len(expired)
+        # the expiry sweep may have dropped stale remote listeners —
+        # re-sync the key's listener-table row (round 24)
+        self._listener_sync(key, st)
         if expired:
             # a cached entry may hold the just-expired values; drop it
             # (the tick re-admits from the store's surviving set)
@@ -1728,6 +1858,7 @@ class Dht:
             self._expire_store_one(key, st)
             if st.empty() and not st.listeners and not st.local_listeners:
                 del self.store[key]
+                self._listener_sync(key, None)
         while self.total_store_size > self.max_store_size:
             if not self.store_quota:
                 log.warning("no space left: local data consumes all quota")

@@ -243,6 +243,12 @@ class DhtRunner:
             try:
                 self._history.add_frame_hook(
                     lambda _frame, _wb=dht.wave_builder: _wb.frame_tick())
+                # listener table (round 24): the same frame cadence
+                # rolls the windowed delivery-lag p95 into the
+                # dht_listener_lag_p95 gauge dhtmon gates on
+                self._history.add_frame_hook(
+                    lambda _frame, _lt=dht.listener_table:
+                        _lt.frame_tick())
             except AttributeError:
                 pass
 
@@ -953,6 +959,7 @@ class DhtRunner:
             waterfall=self.get_profile(),
             pipeline=self.get_pipeline(),
             peers=self.get_peers(),
+            listeners=self.get_listeners(),
         )
 
     def get_bundles(self) -> list:
@@ -1067,6 +1074,22 @@ class DhtRunner:
             if led is None:
                 return {"enabled": False}
             return led.snapshot()
+        except Exception:
+            return {"enabled": False}
+
+    def get_listeners(self) -> dict:
+        """The wave-scale listener-table snapshot (ISSUE-20):
+        occupancy/tombstones/overflow of the device key-id table,
+        buffered puts, match/flush/delivery counters, the windowed
+        delivery-lag p95 and the soonest-expiring entries — the JSON
+        the proxy's ``GET /listeners`` route serves, the ``listeners``
+        REPL command prints, and the scanner's ``listeners`` section
+        embeds."""
+        try:
+            lt = getattr(self._dht, "listener_table", None)
+            if lt is None:
+                return {"enabled": False}
+            return lt.snapshot()
         except Exception:
             return {"enabled": False}
 

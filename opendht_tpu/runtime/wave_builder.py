@@ -334,6 +334,17 @@ class WaveBuilder:
         scatter inline — everything else is left to the drainer job, so
         this fire returns to the runner loop with the device busy."""
         self._job = None
+        # round 24 (ISSUE-20): stored puts buffered since the last
+        # wave ride THIS fire's single listener_match launch — one
+        # coalesced delivery dispatch per wave per listener
+        # (runtime/dht.py flush_listener_wave; the deadline job is the
+        # idle-node fallback)
+        lt = getattr(self._dht, "listener_table", None)
+        if lt is not None and lt.pending():
+            try:
+                self._dht.flush_listener_wave()
+            except Exception:
+                log.exception("listener wave flush failed")
         if not self._pending:
             return
         batch = list(self._pending)

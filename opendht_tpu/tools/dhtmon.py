@@ -76,7 +76,8 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                min_cache_hit: Optional[float] = None,
                max_stage: Optional[dict] = None,
                min_occupancy: Optional[float] = None,
-               max_peer_fail: Optional[float] = None) -> tuple:
+               max_peer_fail: Optional[float] = None,
+               max_listener_lag: Optional[float] = None) -> tuple:
     """Scrape + evaluate; returns ``(violations, doc)`` where ``doc``
     is the JSON-able cluster report and ``violations`` is a list of
     human-readable invariant failures (empty = healthy).
@@ -138,7 +139,16 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
     timeout ratio, so one dying link cannot hide inside healthy
     aggregates.  The SAME unknown contract as ``--max-imbalance``: a
     -1/absent gauge (ledger off, peer evicted, or too little traffic
-    to judge) never violates."""
+    to judge) never violates.
+
+    ``max_listener_lag`` gates the round-24 listener table: the worst
+    node's ``dht_listener_lag_p95`` gauge (windowed p95 of store-time
+    -> coalesced-delivery-dispatch lag through the wave-batched match,
+    seconds) must not exceed it — a drain stall or a fattened flush
+    deadline shows up here before subscribers notice.  The SAME
+    unknown contract as ``--max-imbalance``: a -1/absent gauge (table
+    off, batching off, dark, or no delivery in the window) never
+    violates."""
     alerts = alerts or {}
     violations: List[str] = []
     baseline = None
@@ -314,6 +324,31 @@ def run_checks(endpoints: List[str] = (), runners=(), alerts=None,
                        key=lambda p: p["peer_fail"]
                        if p["peer_fail"] is not None else -1.0)
                    ["endpoint"]))
+    if max_listener_lag is not None and scrapes:
+        # per-node, worst = MAX: the gate is "no node's wave-batched
+        # listen/push delivery is lagging subscribers" — -1/absent =
+        # unknown (table off, batching off, dark, or no delivery
+        # window), never a violation, matching the other gauge gates
+        per_node = []
+        for s in scrapes:
+            vals = [v for name, v in s["series"].items()
+                    if name.startswith("dht_listener_lag_p95")
+                    and v >= 0]
+            per_node.append({"endpoint": s["endpoint"],
+                             "listener_lag": max(vals) if vals else None})
+        known = [p["listener_lag"] for p in per_node
+                 if p["listener_lag"] is not None]
+        worst = max(known) if known else None
+        doc["listener_lag"] = {"max": worst, "per_node": per_node}
+        if worst is not None and worst > max_listener_lag:
+            violations.append(
+                "listener delivery lag p95 %.4fs exceeds %.4fs "
+                "(worst node %s)"
+                % (worst, max_listener_lag,
+                   max(per_node,
+                       key=lambda p: p["listener_lag"]
+                       if p["listener_lag"] is not None else -1.0)
+                   ["endpoint"]))
     if max_stage and scrapes:
         # per-node, worst = MAX p95 per stage: the gate is "no node's
         # serving stage blew its latency budget" — a stage with no
@@ -424,6 +459,15 @@ def main(argv=None) -> int:
                         "evicted, or below Config.peers."
                         "min_signal_events requests) never violates, "
                         "matching the --max-imbalance contract")
+    p.add_argument("--max-listener-lag", type=float, default=None,
+                   metavar="SEC",
+                   help="fail when any node's listener delivery lag "
+                        "p95 (dht_listener_lag_p95: windowed store->"
+                        "coalesced-dispatch lag through the round-24 "
+                        "wave-batched match, seconds) exceeds SEC — "
+                        "unknown (-1/absent: table off, batching off, "
+                        "dark, or no delivery window) never violates, "
+                        "matching the --max-imbalance contract")
     p.add_argument("--max-stage", action="append", default=[],
                    metavar="STAGE=SEC",
                    help="fail when any node's p95 for a round-19 "
@@ -473,7 +517,8 @@ def main(argv=None) -> int:
             min_cache_hit=args.min_cache_hit,
             max_stage=max_stage or None,
             min_occupancy=args.min_occupancy,
-            max_peer_fail=args.max_peer_fail)
+            max_peer_fail=args.max_peer_fail,
+            max_listener_lag=args.max_listener_lag)
     except Exception as e:
         print("dhtmon: scrape failed: %s" % e, file=sys.stderr)
         return 2
@@ -513,6 +558,11 @@ def main(argv=None) -> int:
         if pf:
             print("peer fail ratio: %s (worst link)" % (
                 "%.3f" % pf["max"] if pf["max"] is not None
+                else "unknown"))
+        ll = doc.get("listener_lag")
+        if ll:
+            print("listener lag p95: %s (worst node)" % (
+                "%.4fs" % ll["max"] if ll["max"] is not None
                 else "unknown"))
         for stage, w in sorted((doc.get("stages") or {})
                                .get("worst", {}).items()):

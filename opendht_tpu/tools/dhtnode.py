@@ -82,6 +82,13 @@ REPL ops (cmd_loop, dhtnode.cpp:104-460):
                            transitions — the same data the proxy
                            serves on GET /peers; 'json' dumps the
                            full snapshot
+    listeners [json]       device-resident listener table (round 24):
+                           occupancy/overflow/tombstones, buffered
+                           values awaiting the next wave's batched
+                           match, delivery-lag p95 and the soonest-
+                           expiring entries — the same data the proxy
+                           serves on GET /listeners; 'json' dumps the
+                           full snapshot
     cache [json]           hot-key serving cache (round 16): occupancy,
                            per-entry hit counts, windowed hit ratio,
                            invalidation/eviction totals and the
@@ -478,6 +485,40 @@ def cmd_loop(node, args) -> None:            # noqa: C901 — REPL dispatch
                     fs = snap.get("fail_signal")
                     print("worst-link fail ratio: %s" % (
                         "%.2f" % fs if fs is not None else "unknown"))
+            elif op == "listeners":
+                # device-resident listener table (round 24, ISSUE-20):
+                # same snapshot the proxy serves on GET /listeners
+                import json as _json
+                snap = node.get_listeners()
+                if rest and rest[0] == "json":
+                    print(_json.dumps(snap, indent=2, sort_keys=True))
+                elif not snap.get("enabled"):
+                    print("listener table disabled (batching %s)" % (
+                        snap.get("batching", "?"),))
+                else:
+                    print("%d/%d key(s) tracked (+%d overflow, %d "
+                          "tombstone(s)), %d key(s) buffered" % (
+                              snap.get("occupancy", 0),
+                              snap.get("capacity", 0),
+                              snap.get("overflow", 0),
+                              snap.get("tombstones", 0),
+                              snap.get("buffered", 0)))
+                    print("flushes %d, matches %d, misses %d, "
+                          "deliveries %d (%d value(s)), compactions %d"
+                          % (snap.get("flushes", 0),
+                             snap.get("matches", 0),
+                             snap.get("misses", 0),
+                             snap.get("deliveries", 0),
+                             snap.get("values_delivered", 0),
+                             snap.get("compactions", 0)))
+                    lag = snap.get("lag_p95_s")
+                    print("delivery lag p95: %s" % (
+                        "%.1f ms" % (lag * 1e3)
+                        if lag is not None and lag >= 0
+                        else "unknown"))
+                    for e in snap.get("entries", []):
+                        print("  %s expires in %6.1fs" % (
+                            e["key"], e["ttl_s"]))
             elif op == "bundle":
                 # post-mortem black-box bundle (round 17): same
                 # artifact the proxy serves on GET /debug/bundle

@@ -69,6 +69,7 @@ def topology_snapshot(node) -> dict:
         "waterfall": {},
         "pipeline": {},
         "peers": {},
+        "listeners": {},
         "chaos": {},
         "events": [],
     }
@@ -94,6 +95,14 @@ def topology_snapshot(node) -> dict:
         # link degraded between snapshots (and the wire-map assembler
         # can rebuild the cluster's directed link graph offline)
         snap["peers"] = node.get_peers()
+    except Exception:
+        pass
+    try:
+        # round-24 listener table: occupancy/overflow, buffered keys
+        # and delivery-lag p95, so a soak diff shows WHETHER the
+        # wave-batched listen/push path kept up between snapshots
+        # (next to the peers section's view of the links it pushed on)
+        snap["listeners"] = node.get_listeners()
     except Exception:
         pass
     try:

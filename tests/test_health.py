@@ -562,3 +562,52 @@ def test_dhtmon_imbalance_unknown_never_violates(monkeypatch):
     fake["series"]['dht_shard_imbalance{node="x"}'] = 2.0
     v, doc = dhtmon.run_checks(["n1"], max_imbalance=1.5)
     assert len(v) == 1 and "n1" in v[0]
+
+
+# ----------------------------------------- dhtmon --max-listener-lag
+def test_dhtmon_listener_lag_gate(monkeypatch):
+    """ISSUE-20 satellite: --max-listener-lag gates the worst node's
+    dht_listener_lag_p95 gauge (windowed store->dispatch lag through
+    the round-24 wave-batched match) with the --max-imbalance unknown
+    contract: -1/absent never violates."""
+    from opendht_tpu.tools import dhtmon
+    from opendht_tpu.testing import health_monitor as hm
+    scrapes = {
+        "n1": {'dht_listener_lag_p95{node="a"}': 0.004},
+        "n2": {'dht_listener_lag_p95{node="b"}': 0.200},
+    }
+    monkeypatch.setattr(
+        hm, "scrape_node",
+        lambda ep, timeout=10.0: {"endpoint": ep, "ready": True,
+                                  "verdict": "healthy", "health": {},
+                                  "series": dict(scrapes[ep])})
+    # worst node over the gate violates and is named
+    v, doc = dhtmon.run_checks(["n1", "n2"], max_listener_lag=0.05)
+    assert len(v) == 1 and "n2" in v[0] and "0.2000" in v[0]
+    assert doc["listener_lag"]["max"] == 0.200
+    # both under the gate: healthy, report carries the worst value
+    v, doc = dhtmon.run_checks(["n1", "n2"], max_listener_lag=0.5)
+    assert v == []
+    assert doc["listener_lag"]["max"] == 0.200
+
+
+def test_dhtmon_listener_lag_unknown_never_violates(monkeypatch):
+    from opendht_tpu.tools import dhtmon
+    from opendht_tpu.testing import health_monitor as hm
+    fake = {"ready": True, "verdict": "healthy", "health": {},
+            "series": {'dht_listener_lag_p95{node="x"}': -1.0}}
+    monkeypatch.setattr(hm, "scrape_node",
+                        lambda ep, timeout=10.0: dict(fake, endpoint=ep))
+    # -1 = unknown (table off / dark / no delivery window): no violation
+    v, doc = dhtmon.run_checks(["n1"], max_listener_lag=0.01)
+    assert v == []
+    assert doc["listener_lag"]["max"] is None
+    # absent series: same
+    fake["series"] = {}
+    v, doc = dhtmon.run_checks(["n1"], max_listener_lag=0.01)
+    assert v == []
+    assert doc["listener_lag"]["max"] is None
+    # the CLI rejects a gate violation with exit 1 and names the node
+    fake["series"] = {'dht_listener_lag_p95{node="x"}': 0.5}
+    rc = dhtmon.main(["--nodes", "n1", "--max-listener-lag", "0.01"])
+    assert rc == 1
